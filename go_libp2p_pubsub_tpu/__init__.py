@@ -1,0 +1,43 @@
+"""go-libp2p-pubsub_tpu — a TPU-native pubsub framework.
+
+A ground-up re-design of the capabilities of ``ipfs/go-libp2p-pubsub`` (v0
+dissemination-tree pubsub, reference at ``/root/reference``) for TPU hardware:
+
+- The overlay protocol (join / redirect / admit / forward / repair — reference
+  ``subtree.go``) is expressed as a **data-parallel lockstep state machine**
+  over device-resident peer arrays, advanced by one ``jax.jit``-compiled step
+  function, instead of N goroutine event loops exchanging JSON.
+- The wire protocol (reference ``pubsub.go:122-153``) is kept byte-compatible
+  for the live host plane (``net/live.py``) so a Go peer and a TPU host can
+  interoperate.
+- North-star extensions beyond the v0 reference: GossipSub mesh simulation,
+  vmapped peer scoring, batched ed25519 validation, and an ICI-sharded
+  100k-peer epidemic simulator (``parallel/``).
+
+Public API mirrors the reference's L3/L4 surface (``pubsub.go:19-120``,
+``client.go:18-94``): ``TopicManager``, ``Topic``, ``Subscription``.
+"""
+
+from .config import TreeOpts, SimParams, GossipSubParams, ScoreParams
+from .wire import Message, MessageType, encode_message, decode_message, MessageDecoder
+from .api import TopicManager, Topic, Subscription, SimHost, SimNetwork
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "TreeOpts",
+    "SimParams",
+    "GossipSubParams",
+    "ScoreParams",
+    "Message",
+    "MessageType",
+    "encode_message",
+    "decode_message",
+    "MessageDecoder",
+    "TopicManager",
+    "Topic",
+    "Subscription",
+    "SimHost",
+    "SimNetwork",
+    "__version__",
+]

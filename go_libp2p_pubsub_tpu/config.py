@@ -1,0 +1,193 @@
+"""Typed configuration for the framework.
+
+The reference configures via compile-time constants ``DefaultTreeWidth=2`` /
+``DefaultTreeMaxWidth=5`` (``/root/reference/pubsub.go:16-17``), a per-topic
+variadic ``TreeOpts`` override (``pubsub.go:49-52,66-72``), and the package var
+``SubRepairTimeout = 15s`` (``client.go:14``).  Fanout params also travel over
+the wire inside welcome Updates and are adopted by joiners
+(``subtree.go:211-213`` — unvalidated there; validated here, a documented
+deviation).
+
+This module replaces that with serializable dataclasses: tree/protocol params,
+simulation-scale params, and the GossipSub-era north-star params (mesh degree,
+heartbeat, peer-score weights) that the v0 reference does not have but the
+build target requires (BASELINE.json configs b-e).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict
+
+
+DEFAULT_TREE_WIDTH = 2       # reference pubsub.go:16
+DEFAULT_TREE_MAX_WIDTH = 5   # reference pubsub.go:17
+SUB_REPAIR_TIMEOUT_S = 15.0  # reference client.go:14
+DELIVERY_BUFFER = 16         # reference client.go:79
+
+
+def _validate_positive(name: str, value: int, upper: int = 1 << 20) -> None:
+    if not (0 < value <= upper):
+        raise ValueError(f"{name} must be in (0, {upper}], got {value}")
+
+
+@dataclass(frozen=True)
+class TreeOpts:
+    """Per-topic fanout configuration (reference ``pubsub.go:49-52``).
+
+    ``tree_width`` is the steady-state admission capacity; ``tree_max_width``
+    is the priority capacity used when re-adopting orphans during repair
+    (``subtree.go:110-114``).
+    """
+
+    tree_width: int = DEFAULT_TREE_WIDTH
+    tree_max_width: int = DEFAULT_TREE_MAX_WIDTH
+
+    def __post_init__(self) -> None:
+        _validate_positive("tree_width", self.tree_width)
+        _validate_positive("tree_max_width", self.tree_max_width)
+        if self.tree_max_width < self.tree_width:
+            raise ValueError(
+                f"tree_max_width ({self.tree_max_width}) must be >= "
+                f"tree_width ({self.tree_width})"
+            )
+
+    @classmethod
+    def validated_from_wire(cls, tree_width: int, tree_max_width: int) -> "TreeOpts":
+        """Validate fanout params received in a welcome Update.
+
+        The reference adopts them blind (``subtree.go:211-213``,
+        ``// TODO: check these values``); we reject nonsense instead.
+        """
+        return cls(tree_width=tree_width, tree_max_width=tree_max_width)
+
+
+@dataclass(frozen=True)
+class SimParams:
+    """Shape parameters of the array-resident simulation state.
+
+    All shapes are static (XLA requirement); membership and death are masks.
+
+    - ``max_peers``: row count of every per-peer tensor.
+    - ``max_width``: children-slot count per peer; must be >= the largest
+      ``tree_max_width`` any topic uses.
+    - ``queue_cap``: per-peer inbound FIFO depth — the array form of stream
+      buffering between peers.
+    - ``out_cap``: delivered-message ring per subscriber; the array form of the
+      cap-16 delivery channel (``client.go:79``).  A full ring exerts
+      backpressure exactly as the reference's blocking channel send does
+      (``client.go:124-127``).
+    - ``repair_timeout_steps``: steps an orphan waits for adoption before
+      giving up and re-joining at the root — the array form of
+      ``SubRepairTimeout`` (``client.go:14``), except rejoin is implemented
+      rather than ``panic("not yet implemented")`` (``client.go:96-98``).
+    """
+
+    max_peers: int = 64
+    max_width: int = 8
+    queue_cap: int = 32
+    out_cap: int = 64
+    repair_timeout_steps: int = 64
+
+    def __post_init__(self) -> None:
+        _validate_positive("max_peers", self.max_peers, 1 << 24)
+        _validate_positive("max_width", self.max_width, 1 << 10)
+        _validate_positive("queue_cap", self.queue_cap, 1 << 16)
+        _validate_positive("out_cap", self.out_cap, 1 << 16)
+
+
+@dataclass(frozen=True)
+class GossipSubParams:
+    """GossipSub v1.1 protocol parameters (north-star configs b, e).
+
+    These mirror the public GossipSub spec's D/Dlo/Dhi/heartbeat family —
+    absent from the v0 reference, required by BASELINE.json ("GossipSub D=6
+    mesh, 1k-peer heartbeat sim").
+    """
+
+    d: int = 6                 # target mesh degree
+    d_lo: int = 4              # graft below
+    d_hi: int = 12             # prune above
+    d_score: int = 4           # best-scoring peers kept on oversubscription
+    d_lazy: int = 6            # gossip emission degree
+    d_out: int = 2             # min outbound-mesh degree (v1.1)
+    history_length: int = 5    # mcache windows kept
+    history_gossip: int = 3    # windows advertised in IHAVE
+    heartbeat_interval_s: float = 1.0
+    fanout_ttl_s: float = 60.0
+    gossip_factor: float = 0.25
+    opportunistic_graft_peers: int = 2
+    max_ihave_length: int = 5000
+    seen_ttl_s: float = 120.0
+
+    def __post_init__(self) -> None:
+        if not (self.d_lo <= self.d <= self.d_hi):
+            raise ValueError("require d_lo <= d <= d_hi")
+        if self.history_gossip > self.history_length:
+            raise ValueError("history_gossip must be <= history_length")
+
+
+@dataclass(frozen=True)
+class ScoreParams:
+    """Peer-score function weights (GossipSub v1.1; north-star config d).
+
+    Topic-level components P1-P4 plus global P5-P7, with decay. Defaults are
+    benign placeholders; attack-trace benchmarks override them.
+    """
+
+    # P1: time in mesh
+    time_in_mesh_weight: float = 0.01
+    time_in_mesh_quantum_s: float = 1.0
+    time_in_mesh_cap: float = 3600.0
+    # P2: first message deliveries
+    first_message_deliveries_weight: float = 1.0
+    first_message_deliveries_decay: float = 0.5
+    first_message_deliveries_cap: float = 2000.0
+    # P3: mesh message delivery deficit (squared)
+    mesh_message_deliveries_weight: float = -1.0
+    mesh_message_deliveries_decay: float = 0.5
+    mesh_message_deliveries_threshold: float = 20.0
+    mesh_message_deliveries_cap: float = 100.0
+    mesh_message_deliveries_activation_s: float = 5.0
+    # P3b: mesh failure penalty (sticky)
+    mesh_failure_penalty_weight: float = -1.0
+    mesh_failure_penalty_decay: float = 0.5
+    # P4: invalid messages (squared)
+    invalid_message_deliveries_weight: float = -1.0
+    invalid_message_deliveries_decay: float = 0.3
+    # topic weight applied to P1-P4 sum
+    topic_weight: float = 1.0
+    topic_score_cap: float = 100.0
+    # P5: application-specific (supplied externally)
+    app_specific_weight: float = 1.0
+    # P6: IP colocation
+    ip_colocation_factor_weight: float = -1.0
+    ip_colocation_factor_threshold: float = 1.0
+    # P7: behavioural penalty (squared)
+    behaviour_penalty_weight: float = -1.0
+    behaviour_penalty_threshold: float = 0.0
+    behaviour_penalty_decay: float = 0.9
+    # score thresholds
+    gossip_threshold: float = -10.0
+    publish_threshold: float = -50.0
+    graylist_threshold: float = -80.0
+    accept_px_threshold: float = 10.0
+    opportunistic_graft_threshold: float = 1.0
+    decay_interval_s: float = 1.0
+    decay_to_zero: float = 0.01
+    retain_score_s: float = 3600.0
+
+
+def to_dict(cfg: Any) -> Dict[str, Any]:
+    """Serialize any config dataclass to a plain dict."""
+    return dataclasses.asdict(cfg)
+
+
+def to_json(cfg: Any) -> str:
+    return json.dumps(to_dict(cfg), sort_keys=True)
+
+
+def tree_opts_from_dict(d: Dict[str, Any]) -> TreeOpts:
+    return TreeOpts(**d)

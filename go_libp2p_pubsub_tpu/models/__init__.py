@@ -1,0 +1,1 @@
+"""Protocol models: treecast (v0 parity flagship), floodsub, gossipsub."""

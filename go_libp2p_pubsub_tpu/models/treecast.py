@@ -1,0 +1,62 @@
+"""TreeCast — the flagship v0-parity model.
+
+The reference's single-rooted dissemination tree (``/root/reference/
+subtree.go``) packaged as a model: static-shape state init, a jittable
+lockstep ``forward`` step, and a demo-state builder used by the graft entry
+point and benchmarks.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config import SimParams, TreeOpts
+from ..ops import tree as tree_ops
+from ..ops.tree import TreeState
+
+
+class TreeCast:
+    """Data-parallel dissemination-tree pubsub over ``max_peers`` rows."""
+
+    def __init__(self, params: SimParams | None = None, opts: TreeOpts | None = None):
+        self.params = params or SimParams()
+        self.opts = opts or TreeOpts()
+
+    def init(self, root: int = 0) -> TreeState:
+        return tree_ops.init_state(self.params, self.opts, root=root)
+
+    @staticmethod
+    def forward(state: TreeState) -> TreeState:
+        """One lockstep network transition — the jittable hot path."""
+        return tree_ops.step(state)
+
+    def build_demo_state(self, n_peers: int, n_msgs: int = 4) -> TreeState:
+        """A small joined tree with queued traffic, for compile checks/bench.
+
+        Runs the join walk host-side (each subscribe is a few steps) then
+        enqueues ``n_msgs`` publishes at the root.
+        """
+        if n_peers > self.params.max_peers:
+            raise ValueError("n_peers exceeds SimParams.max_peers")
+        st = self.init(root=0)
+        for p in range(1, n_peers):
+            st = tree_ops.begin_subscribe(st, jnp.int32(p))
+            for _ in range(4 * n_peers):
+                if bool(st.joined[p]):
+                    break
+                st = tree_ops.step(st)
+        for m in range(n_msgs):
+            st = tree_ops.publish(st, jnp.int32(m))
+        return st
+
+
+def entry_fn_and_args(
+    n_peers: int = 16, params: SimParams | None = None
+) -> Tuple[callable, Tuple[TreeState]]:
+    """(jittable forward, example args) for the driver's compile check."""
+    model = TreeCast(params or SimParams(max_peers=max(16, n_peers)))
+    state = model.build_demo_state(n_peers)
+    return TreeCast.forward, (state,)

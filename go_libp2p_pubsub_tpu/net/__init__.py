@@ -1,0 +1,1 @@
+"""Network planes: in-array simulated fabric and the live asyncio host plane."""

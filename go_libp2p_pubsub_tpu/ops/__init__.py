@@ -1,0 +1,1 @@
+"""Jitted array kernels: tree overlay, gossip, scoring, validation, graph utils."""

@@ -1,0 +1,76 @@
+"""Graph/segment utilities shared by the overlay and gossip kernels.
+
+These are the array-program primitives that replace the reference's
+per-node Go data structures: segment ranking replaces "who gets the next
+child slot" serialization under ``chlock`` (``subtree.go:18``), and masked
+argmin replaces the min-size child scan in ``redirectJoin``
+(``subtree.go:161-169``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+INVALID = jnp.int32(-1)
+BIG_I32 = jnp.int32(2**31 - 1)
+
+
+def segment_rank(targets: jax.Array, mask: jax.Array) -> jax.Array:
+    """Rank of each masked element among elements sharing its target.
+
+    ``targets``: i32[N] target index per element; ``mask``: bool[N] selects
+    participating elements.  Returns i32[N]: 0-based ordinal (stable by
+    element index) within each target group; unmasked elements get 0.
+
+    This is how concurrent joiners aiming at the same parent are ordered
+    where the reference serialized them under the parent's ``chlock``
+    (``subtree.go:101-103``).
+    """
+    n = targets.shape[0]
+    key = jnp.where(mask, targets, n).astype(jnp.int32)
+    # Stable sort by (key, index): compose into one sortable key.
+    composite = key * jnp.int32(n + 1) + jnp.arange(n, dtype=jnp.int32)
+    order = jnp.argsort(composite)
+    sorted_key = key[order]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    is_first = jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), sorted_key[1:] != sorted_key[:-1]]
+    )
+    seg_start = jax.lax.cummax(jnp.where(is_first, pos, 0))
+    rank_sorted = pos - seg_start
+    return jnp.zeros((n,), jnp.int32).at[order].set(rank_sorted)
+
+
+def masked_argmin(values: jax.Array, mask: jax.Array, axis: int = -1) -> jax.Array:
+    """Index of the minimum among masked entries (ties -> lowest index).
+
+    The array form of the min-size live-child scan in ``redirectJoin``
+    (``subtree.go:161-169``), without its all-dead nil-deref bug
+    (``subtree.go:172-176``): with an all-false mask the result is 0 and the
+    caller must check ``mask.any(axis)`` itself.
+    """
+    v = jnp.where(mask, values, BIG_I32)
+    return jnp.argmin(v, axis=axis).astype(jnp.int32)
+
+
+def safe_gather(arr: jax.Array, idx: jax.Array, fill=0):
+    """Gather ``arr[idx]`` treating negative indices as invalid -> ``fill``."""
+    valid = idx >= 0
+    clipped = jnp.clip(idx, 0, arr.shape[0] - 1)
+    out = arr[clipped]
+    if out.ndim > valid.ndim:  # row gather from a 2D table: broadcast the mask
+        valid = valid.reshape(valid.shape + (1,) * (out.ndim - valid.ndim))
+    return jnp.where(valid, out, fill)
+
+
+def nth_free_slot(row_used: jax.Array, rank: jax.Array) -> jax.Array:
+    """Index of the ``rank``-th free (False) slot in a boolean row.
+
+    ``row_used``: bool[W]; ``rank``: scalar i32.  Returns W when there is no
+    such slot (caller scatters with mode='drop').
+    """
+    w = row_used.shape[0]
+    slot_ids = jnp.where(~row_used, jnp.arange(w, dtype=jnp.int32), w)
+    ordered = jnp.sort(slot_ids)
+    return jnp.where(rank < w, ordered[jnp.clip(rank, 0, w - 1)], w).astype(jnp.int32)

@@ -1,0 +1,456 @@
+"""The dissemination-tree overlay as a data-parallel lockstep state machine.
+
+Design
+------
+The reference (``/root/reference/subtree.go``) runs one event loop per peer:
+goroutines block on stream reads, joins serialize under ``chlock``, fan-out is
+a serial loop over a children map, and repair runs inline in the publish path.
+The TPU-native formulation inverts this: **all N simulated peers are rows of
+device-resident arrays** and one ``jax.jit``-compiled :func:`step` advances the
+whole network synchronously.  Protocol actions map as:
+
+==============================================  =================================
+reference mechanism                             array mechanism (here)
+==============================================  =================================
+``handleJoin`` admit under ``chlock``           phase B: segment-ranked
+  (``subtree.go:110-154``)                      concurrent admission
+``redirectJoin`` min-size child walk            phase B: masked argmin redirect,
+  (``subtree.go:156-194``)                      one hop per step
+``forwardMessage`` serial fan-out + write-      phase C: vectorized scatter to
+  error detect (``subtree.go:319-354``)         child queues + dead-detect mask
+``redistributeChildren`` priority re-joins      phase D/A: orphans get
+  (``subtree.go:356-375``)                      ``join_target = grandparent``
+                                                with priority capacity
+``Part`` graceful leave (``subtree.go:78-98``)  phase A
+pause/15 s repair timeout/``rejoinRoot`` panic  phase E watchdog; rejoin at root
+  (``client.go:96-122``)                        is *implemented* (deviation)
+``State`` size accounting (``subtree.go:137``)  phase F: iterated bottom-up
+                                                subtree-size fixed point
+==============================================  =================================
+
+Messages are device-side ``int32`` ids; payload bytes stay host-side in the
+engine (api.py).  Static shapes throughout: membership and death are masks,
+redirect walks advance one hop per lockstep step (bounded by tree depth).
+
+Deliberate deviations from reference bugs, per SURVEY.md §2.4 (observable
+test behavior preserved): real subtree sizes (§2.4.3), full grandchild lists
+during repair (§2.4.4), no all-dead nil-deref (§2.4.5), rejoin-at-root instead
+of ``panic`` on repair timeout (§2.4.8), wire fanout params validated (§2.4.10).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config import SimParams, TreeOpts
+from .graphs import masked_argmin, nth_free_slot, safe_gather, segment_rank
+
+NO_PEER = -1  # empty slot / no parent / no target
+NO_MSG = -1
+
+
+class TreeState(NamedTuple):
+    """Device-resident per-peer state of one topic tree.
+
+    Shapes: N = max_peers, W = max_width, Q = queue_cap, OC = out_cap.
+    """
+
+    parent: jax.Array        # i32[N]  parent peer or NO_PEER
+    children: jax.Array      # i32[N, W] child peers, NO_PEER = empty slot
+    alive: jax.Array         # bool[N] process liveness (host kills abruptly here)
+    joined: jax.Array        # bool[N] attached to the tree
+    leaving: jax.Array       # bool[N] graceful Part requested
+    join_target: jax.Array   # i32[N]  current join/redirect candidate, NO_PEER = none
+    join_prio: jax.Array     # bool[N] priority join (repair adoption, subtree.go:110-114)
+    join_wait: jax.Array     # i32[N]  steps spent waiting to be (re)joined
+    subtree_size: jax.Array  # i32[N]  peers in own subtree incl. self
+    q: jax.Array             # i32[N, Q] inbound message ring
+    q_head: jax.Array        # i32[N]
+    q_len: jax.Array         # i32[N]
+    out: jax.Array           # i32[N, OC] delivered-message ring (client.out analog)
+    out_len: jax.Array       # i32[N]  total delivered (monotonic)
+    out_drained: jax.Array   # i32[N]  host-consumed count (backpressure boundary)
+    root: jax.Array          # i32[]   topic root peer
+    width: jax.Array         # i32[]   steady-state fanout (TreeWidth)
+    max_width: jax.Array     # i32[]   priority fanout (TreeMaxWidth)
+    step_num: jax.Array      # i32[]
+
+
+def init_state(params: SimParams, opts: TreeOpts, root: int = 0) -> TreeState:
+    if params.max_width < opts.tree_max_width:
+        raise ValueError(
+            f"SimParams.max_width ({params.max_width}) must be >= "
+            f"TreeOpts.tree_max_width ({opts.tree_max_width})"
+        )
+    n, w = params.max_peers, params.max_width
+    i32 = jnp.int32
+    st = TreeState(
+        parent=jnp.full((n,), NO_PEER, i32),
+        children=jnp.full((n, w), NO_PEER, i32),
+        alive=jnp.zeros((n,), bool).at[root].set(True),
+        joined=jnp.zeros((n,), bool).at[root].set(True),
+        leaving=jnp.zeros((n,), bool),
+        join_target=jnp.full((n,), NO_PEER, i32),
+        join_prio=jnp.zeros((n,), bool),
+        join_wait=jnp.zeros((n,), i32),
+        subtree_size=jnp.zeros((n,), i32).at[root].set(1),
+        q=jnp.full((n, params.queue_cap), NO_MSG, i32),
+        q_head=jnp.zeros((n,), i32),
+        q_len=jnp.zeros((n,), i32),
+        out=jnp.full((n, params.out_cap), NO_MSG, i32),
+        out_len=jnp.zeros((n,), i32),
+        out_drained=jnp.zeros((n,), i32),
+        root=jnp.asarray(root, i32),
+        width=jnp.asarray(opts.tree_width, i32),
+        max_width=jnp.asarray(opts.tree_max_width, i32),
+        step_num=jnp.asarray(0, i32),
+    )
+    return st
+
+
+# ---------------------------------------------------------------------------
+# host-triggered events (all jittable single-peer updates)
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def begin_subscribe(st: TreeState, peer: jax.Array) -> TreeState:
+    """Peer dials the root and starts the join walk (client.go:65-94).
+
+    The walk itself happens one redirect-hop per :func:`step`, mirroring the
+    recursive ``joinParents`` chain (``subtree.go:241-307``) whose depth is
+    the tree depth.
+    """
+    return st._replace(
+        alive=st.alive.at[peer].set(True),
+        join_target=st.join_target.at[peer].set(st.root),
+        join_prio=st.join_prio.at[peer].set(False),
+        join_wait=st.join_wait.at[peer].set(0),
+    )
+
+
+@jax.jit
+def kill_peer(st: TreeState, peer: jax.Array) -> TreeState:
+    """Abrupt death — no Part is sent (TestNodesDropping's ``hosts[1].Close()``).
+
+    Detection happens lazily at the next forward attempt, like the write-error
+    path in ``forwardMessage`` (``subtree.go:333-336``).
+    """
+    return st._replace(alive=st.alive.at[peer].set(False))
+
+
+@jax.jit
+def leave_peer(st: TreeState, peer: jax.Array) -> TreeState:
+    """Graceful leave — Part to parent next step (``subtree.go:78-98``)."""
+    return st._replace(leaving=st.leaving.at[peer].set(True))
+
+
+@jax.jit
+def publish(st: TreeState, msg_id: jax.Array) -> TreeState:
+    """Root-side ``PublishMessage`` (``pubsub.go:111-120``): enqueue at root.
+
+    The root's queue feeds phase C, which fans out to children; the root never
+    delivers to its own out-ring (the reference root is publisher, not
+    subscriber).
+    """
+    r = st.root
+    tail = (st.q_head[r] + st.q_len[r]) % st.q.shape[1]
+    return st._replace(
+        q=st.q.at[r, tail].set(msg_id),
+        q_len=st.q_len.at[r].add(1),
+    )
+
+
+@jax.jit
+def drain_out(st: TreeState, peer: jax.Array):
+    """Host reads a subscriber's delivered-message ring (client.Messages()).
+
+    Returns (new_state, msgs i32[OC], count): ``msgs[:count]`` are the ids
+    delivered since the last drain, oldest first.  Draining releases
+    backpressure the way reading ``client.out`` unblocks the sender
+    (``client.go:124-127``).
+    """
+    oc = st.out.shape[1]
+    start = st.out_drained[peer]
+    count = st.out_len[peer] - start
+    idx = (start + jnp.arange(oc, dtype=jnp.int32)) % oc
+    msgs = jnp.where(jnp.arange(oc) < count, st.out[peer][idx], NO_MSG)
+    return st._replace(out_drained=st.out_drained.at[peer].set(st.out_len[peer])), msgs, count
+
+
+# ---------------------------------------------------------------------------
+# the lockstep transition
+# ---------------------------------------------------------------------------
+
+def _phase_part(st: TreeState) -> TreeState:
+    """Graceful leaves: Part to parent, parent redistributes grandchildren.
+
+    Mirrors ``subtree.Close`` (``subtree.go:78-98``) + the parent's Part
+    handling (``subtree.go:62-70``) + ``redistributeChildren``
+    (``subtree.go:356-375``): orphans of the leaver are re-adopted by the
+    leaver's parent with priority capacity.  Unlike the reference (§2.4.4),
+    *all* grandchildren are recovered, not just the most recently joined.
+    """
+    leaver = st.leaving & st.alive & st.joined & (jnp.arange(st.parent.shape[0]) != st.root)
+
+    # Parent forgets leaving children (slot cleared).
+    ch_is_leaver = safe_gather(leaver, st.children.reshape(-1), False).reshape(st.children.shape)
+    children = jnp.where(ch_is_leaver, NO_PEER, st.children)
+
+    # Orphans: children of leavers -> adopt at leaver's parent, priority.
+    parent_is_leaver = safe_gather(leaver, st.parent, False)
+    orphan = st.joined & st.alive & parent_is_leaver
+    grandp = safe_gather(st.parent, st.parent, NO_PEER)  # leaver's parent
+    grandp = jnp.where(grandp >= 0, grandp, st.root)
+    join_target = jnp.where(orphan, grandp, st.join_target)
+    join_prio = jnp.where(orphan, True, st.join_prio)
+    join_wait = jnp.where(orphan, 0, st.join_wait)
+    parent = jnp.where(orphan, NO_PEER, st.parent)
+
+    # Leaver rows torn down (alive=False: the subscriber process exits after
+    # Part, like client.Close() -> sub.Close()).
+    parent = jnp.where(leaver, NO_PEER, parent)
+    children = jnp.where(leaver[:, None], NO_PEER, children)
+    return st._replace(
+        parent=parent,
+        children=children,
+        alive=st.alive & ~leaver,
+        joined=st.joined & ~leaver,
+        leaving=jnp.zeros_like(st.leaving),
+        join_target=join_target,
+        join_prio=join_prio,
+        join_wait=join_wait,
+    )
+
+
+def _phase_watchdog(st: TreeState, timeout_steps: int) -> TreeState:
+    """Orphan pause/timeout: the array form of ``processMessages``' pause
+    select (``client.go:105-122``).
+
+    An orphan (dead/absent parent, no repair assignment yet) waits for the
+    grandparent's repair dial; past ``timeout_steps`` it rejoins at the root —
+    the reference's unimplemented ``rejoinRoot`` (``client.go:96-98``), fixed.
+    Joiners stuck in a redirect walk are bounded the same way.
+    """
+    n = st.parent.shape[0]
+    is_root = jnp.arange(n) == st.root
+    parent_ok = safe_gather(st.alive & st.joined, st.parent, False)
+    orphan = st.joined & st.alive & ~is_root & ((st.parent < 0) | ~parent_ok) & (st.join_target < 0)
+    waiting = orphan | (st.join_target >= 0)
+    join_wait = jnp.where(waiting, st.join_wait + 1, 0)
+    timed_out = waiting & (join_wait > timeout_steps)
+    return st._replace(
+        join_wait=jnp.where(timed_out, 0, join_wait),
+        join_target=jnp.where(timed_out, st.root, st.join_target),
+        join_prio=jnp.where(timed_out, False, st.join_prio),
+    )
+
+
+def _phase_join(st: TreeState) -> TreeState:
+    """Concurrent admission/redirect: ``handleJoin`` + ``redirectJoin``.
+
+    Every peer with a ``join_target`` attempts one protocol round this step:
+    admitted into a free child slot if the target has capacity (priority
+    joiners get ``max_width``, ``subtree.go:110-119``), otherwise redirected
+    to the target's minimum-size live child (``subtree.go:161-185``) and the
+    walk continues next step.  Concurrent joiners at one target are ordered by
+    segment rank — the array analog of ``chlock`` serialization.
+    """
+    n, w = st.children.shape
+    joiner = (st.join_target >= 0) & st.alive
+
+    # Target sanity: dead/unjoined target -> restart at root (reference would
+    # surface a stream error and the client would retry; bounded here).
+    t_ok = safe_gather(st.alive & st.joined, st.join_target, False)
+    target = jnp.where(joiner & ~t_ok, st.root, st.join_target)
+
+    n_children = jnp.sum(st.children >= 0, axis=1).astype(jnp.int32)
+    cap_w = jnp.where(st.join_prio, st.max_width, st.width)  # per-joiner capacity rule
+    capacity = jnp.maximum(cap_w - safe_gather(n_children, target, 0), 0)
+
+    rank = segment_rank(target, joiner)
+    admitted = joiner & (rank < capacity)
+
+    # --- admissions -> fill the target's free slots in admit-rank order.
+    admit_rank = segment_rank(target, admitted)
+    used = st.children >= 0
+    target_used = safe_gather(used, jnp.clip(target, 0, n - 1), True)  # bool[N, W] rows
+    slots = jax.vmap(nth_free_slot)(target_used, admit_rank)  # i32[N], == W when none
+    scatter_t = jnp.where(admitted, target, n)  # row n/col W dropped
+    scatter_s = jnp.where(admitted, slots, w)
+    children = st.children.at[scatter_t, scatter_s].set(
+        jnp.arange(n, dtype=jnp.int32), mode="drop"
+    )
+    parent = jnp.where(admitted, target, st.parent)
+    joined = st.joined | admitted
+    join_target = jnp.where(admitted, NO_PEER, target)
+    join_prio = jnp.where(admitted, False, st.join_prio)
+    join_wait = jnp.where(admitted, 0, st.join_wait)
+
+    # --- redirects -> hop to min-subtree-size live child of the target.
+    redirected = joiner & ~admitted
+    t_children = st.children[jnp.clip(target, 0, n - 1)]          # i32[N, W]
+    t_ch_live = safe_gather(st.alive & st.joined, t_children.reshape(-1), False).reshape(n, w)
+    t_ch_live &= t_children >= 0
+    t_ch_size = safe_gather(st.subtree_size, t_children.reshape(-1), 0).reshape(n, w)
+    has_live_child = t_ch_live.any(axis=1)
+    best = masked_argmin(t_ch_size, t_ch_live)
+    redir_to = jnp.take_along_axis(t_children, best[:, None], axis=1)[:, 0]
+    # No live child to redirect to (the reference's nil-deref case,
+    # subtree.go:172-176): retry the same target next step.
+    join_target = jnp.where(redirected & has_live_child, redir_to, join_target)
+
+    return st._replace(
+        parent=parent,
+        children=children,
+        joined=joined,
+        join_target=join_target,
+        join_prio=join_prio,
+        join_wait=join_wait,
+    )
+
+
+def _phase_data(st: TreeState):
+    """Data plane: pop one message per peer, deliver, fan out to children.
+
+    Mirrors ``processMessages`` (``client.go:100-132``): delivery to the out
+    ring happens *before* forwarding, and a peer only processes when its out
+    ring has room and every live child queue has room — the array form of the
+    blocking channel send + blocking stream writes (backpressure by design).
+    Writes to dead children are dropped and flagged, like the write-error path
+    in ``forwardMessage`` (``subtree.go:333-336``).
+
+    Returns (state, dead_detect bool[N, W]).
+    """
+    n, w = st.children.shape
+    qcap = st.q.shape[1]
+    oc = st.out.shape[1]
+    is_root = jnp.arange(n) == st.root
+
+    ch_ok = safe_gather(st.alive & st.joined, st.children.reshape(-1), False).reshape(n, w)
+    ch_ok &= st.children >= 0
+    ch_qlen = safe_gather(st.q_len, st.children.reshape(-1), 0).reshape(n, w)
+    child_room = jnp.where(ch_ok, ch_qlen < qcap, True).all(axis=1)
+    out_room = is_root | ((st.out_len - st.out_drained) < oc)
+
+    popper = st.alive & st.joined & (st.q_len > 0) & out_room & child_room
+    msg = st.q[jnp.arange(n), st.q_head % qcap]
+    q_head = jnp.where(popper, (st.q_head + 1) % qcap, st.q_head)
+    q_len = jnp.where(popper, st.q_len - 1, st.q_len)
+
+    # Deliver (non-root): append to out ring.
+    deliver = popper & ~is_root
+    out = st.out.at[
+        jnp.where(deliver, jnp.arange(n), n), st.out_len % oc, # row n dropped
+    ].set(msg, mode="drop")
+    out_len = jnp.where(deliver, st.out_len + 1, st.out_len)
+
+    # Forward: scatter msg into each live child's queue tail.  Each child has
+    # exactly one parent, so targets are unique — no write conflicts.
+    fwd = popper[:, None] & (st.children >= 0)
+    fwd_live = fwd & ch_ok
+    cidx = jnp.where(fwd_live, st.children, n).reshape(-1)
+    ctail = (safe_gather(q_head, cidx, 0) + safe_gather(q_len, cidx, 0)) % qcap
+    q = st.q.at[cidx, ctail].set(jnp.repeat(msg, w), mode="drop")
+    q_len = q_len.at[cidx].add(jnp.where(cidx < n, 1, 0), mode="drop")
+
+    dead_detect = fwd & ~ch_ok  # write failure -> repair in phase D
+    return (
+        st._replace(q=q, q_head=q_head, q_len=q_len, out=out, out_len=out_len),
+        dead_detect,
+    )
+
+
+def _phase_repair(st: TreeState, dead_detect: jax.Array) -> TreeState:
+    """Write-failure repair: ``forwardMessage``'s dead-reap +
+    ``redistributeChildren`` (``subtree.go:342-350, 356-375``).
+
+    The detecting parent removes the dead child and adopts *all* of its
+    recorded children with priority joins (full-list fix of §2.4.4).  Orphan
+    rows keep their own children and queue backlog — repair swaps only the
+    parent edge, like the pause/resume stream swap (``client.go:106-122``).
+    """
+    n, w = st.children.shape
+    # Which peers were detected dead, and by whom.
+    dead_ids = jnp.where(dead_detect, st.children, n).reshape(-1)
+    dead_set = jnp.zeros((n,), bool).at[dead_ids].set(True, mode="drop")
+    dead_set &= ~(st.alive & st.joined)  # only actually-dead peers
+
+    # Orphans: children of detected-dead peers.  The adopter is the detecting
+    # parent == parent[dead] (still recorded on the dead row).
+    parent_dead = safe_gather(dead_set, st.parent, False)
+    orphan = st.joined & st.alive & parent_dead
+    adopter = safe_gather(st.parent, st.parent, NO_PEER)
+    adopter = jnp.where(adopter >= 0, adopter, st.root)
+    join_target = jnp.where(orphan, adopter, st.join_target)
+    join_prio = jnp.where(orphan, True, st.join_prio)
+    join_wait = jnp.where(orphan, 0, st.join_wait)
+    parent = jnp.where(orphan, NO_PEER, st.parent)
+
+    # Tear down dead rows; drop dead children from their parents' slot lists.
+    ch_dead = safe_gather(dead_set, st.children.reshape(-1), False).reshape(n, w)
+    children = jnp.where(ch_dead, NO_PEER, st.children)
+    children = jnp.where(dead_set[:, None], NO_PEER, children)
+    parent = jnp.where(dead_set, NO_PEER, parent)
+    return st._replace(
+        parent=parent,
+        children=children,
+        joined=st.joined & ~dead_set,
+        join_target=join_target,
+        join_prio=join_prio,
+        join_wait=join_wait,
+    )
+
+
+def _phase_sizes(st: TreeState, iters: int) -> TreeState:
+    """Recompute subtree sizes bottom-up (fixed point over tree depth).
+
+    The correct-semantics replacement for the reference's broken ``State``
+    accounting (``sub.size`` never incremented, §2.4.3): sizes here are real,
+    so redirect load-balancing actually balances.
+    """
+    n, w = st.children.shape
+    member = st.alive & st.joined
+
+    def body(_, sizes):
+        ch = safe_gather(sizes, st.children.reshape(-1), 0).reshape(n, w)
+        ch = jnp.where(st.children >= 0, ch, 0)
+        return jnp.where(member, 1 + ch.sum(axis=1), 0).astype(jnp.int32)
+
+    sizes = jax.lax.fori_loop(0, iters, body, jnp.where(member, 1, 0).astype(jnp.int32))
+    return st._replace(subtree_size=sizes)
+
+
+@functools.partial(jax.jit, static_argnames=("size_iters", "repair_timeout_steps"))
+def step(st: TreeState, size_iters: int = 0, repair_timeout_steps: int = 64) -> TreeState:
+    """One lockstep transition of the whole network.
+
+    Phase order encodes the reference's observable ordering:
+
+    A. graceful Parts are handled before data flows (a Part is read by the
+       parent's ``handleChildMessages`` goroutine independent of publishes),
+       so graceful leaves lose no messages except to the leaver — the
+       TestNodesDroppingGracefully contract;
+    B. watchdog + join/redirect rounds (control plane);
+    C. data pop/deliver/forward with write-failure detection — a message
+       published after an abrupt kill is lost to the dead subtree because
+       detection happens *during* that forward, exactly like the inline
+       repair in ``forwardMessage`` (``subtree.go:342-350``) — the
+       TestNodesDropping loss-window contract;
+    D. repair assignments from this step's write failures (orphans join next
+       step, so the loss window is one hop per tree level);
+    E. subtree-size refresh for redirect balancing.
+    """
+    if size_iters <= 0:
+        size_iters = max(2, int(math.ceil(math.log2(max(2, st.parent.shape[0])))) + 1)
+    st = _phase_part(st)
+    st = _phase_watchdog(st, repair_timeout_steps)
+    st = _phase_join(st)
+    st, dead_detect = _phase_data(st)
+    st = _phase_repair(st, dead_detect)
+    st = _phase_sizes(st, size_iters)
+    return st._replace(step_num=st.step_num + 1)

@@ -1,0 +1,1 @@
+"""Multi-device scaling: meshes, shardings, collective propagation kernels."""

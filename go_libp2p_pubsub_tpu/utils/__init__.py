@@ -1,0 +1,1 @@
+"""Aux subsystems: logging, metrics, checkpointing, fault injection, tracing."""

@@ -1,0 +1,206 @@
+"""Behavioral parity suite: the reference's four integration tests.
+
+Each test here reproduces one test from ``/root/reference/pubsub_test.go`` on
+the array sim backend, with the same observable contract: exact-bytes
+delivery, per-subscriber FIFO order, loss windows scoped to the failed
+subtree (encoded as skip-sets), and bounded reconvergence.  Wall-clock
+timeouts/settles map to lockstep step budgets.
+"""
+
+import pytest
+
+from go_libp2p_pubsub_tpu.api import (
+    SimNetwork,
+    Subscription,
+    TimeoutError_,
+    Topic,
+    TopicManager,
+)
+from go_libp2p_pubsub_tpu.config import SimParams, TreeOpts
+
+
+def init_pubsub(net, hosts):
+    """``initPubsub`` analog (pubsub_test.go:65-83): host 0 roots "foobar",
+    hosts 1..N-1 subscribe.  subchs[i] <-> hosts[i+1]."""
+    tms = [TopicManager(h) for h in hosts]
+    topic = tms[0].new_topic("foobar")
+    subchs = [tm.subscribe(hosts[0].id, "foobar") for tm in tms[1:]]
+    return topic, tms, subchs
+
+
+def check_system(topic: Topic, subs, skip=None, mid=0):
+    """``checkSystem`` analog (pubsub_test.go:101-131): publish one message,
+    assert every non-skipped subscriber receives those exact bytes."""
+    skip = skip or set()
+    mes = f"message number {mid}".encode()
+    topic.publish_message(mes)
+    for i, ch in enumerate(subs):
+        if i in skip:
+            continue
+        data = ch.get()
+        assert data == mes, f"wrong data on node {i}: expected {mes!r} got {data!r}"
+
+
+def settle_and_clear(net, subs, steps=16):
+    """The 100 ms settle + ``clearWaitingMessages`` (pubsub_test.go:85-99,191)."""
+    net.step(steps)
+    for s in subs:
+        if not s.closed:
+            s.clear()
+
+
+def test_basic_pubsub():
+    """``TestBasicPubsub`` (pubsub_test.go:133-155): 4 nodes, 10 sequential
+    messages delivered to all 3 subscribers."""
+    net = SimNetwork(SimParams(max_peers=8))
+    hosts = net.make_hosts(4)
+    topic, _, subchs = init_pubsub(net, hosts)
+    for i in range(10):
+        check_system(topic, subchs, None, i)
+
+
+def test_nodes_dropping():
+    """``TestNodesDropping`` (pubsub_test.go:158-202): abrupt kill of
+    hosts[1]; the in-flight message may be lost in its subtree only; full
+    recovery afterwards minus the killed node."""
+    net = SimNetwork(SimParams(max_peers=8))
+    hosts = net.make_hosts(4)
+    topic, _, subchs = init_pubsub(net, hosts)
+
+    check_system(topic, subchs, None, 0)
+
+    hosts[1].close()  # abrupt: no Part (pubsub_test.go:178)
+
+    # Loss allowed at the killed node and possibly its child (skip {0,2}).
+    check_system(topic, subchs, {0, 2}, 1)
+
+    settle_and_clear(net, subchs)
+    for i in range(10):
+        check_system(topic, subchs, {0}, i + 100)
+
+
+def test_lower_nodes_dropping():
+    """``TestLowerNodesDropping`` (pubsub_test.go:231-279): 8 nodes, kill the
+    interior node hosts[3]; loss window covers its subtree; recovery re-homes
+    the orphaned grandchildren."""
+    net = SimNetwork(SimParams(max_peers=16))
+    hosts = net.make_hosts(8)
+    topic, _, subchs = init_pubsub(net, hosts)
+
+    check_system(topic, subchs, None, 0)
+
+    hosts[3].close()
+    net.step(8)  # the 100 ms settle before the lossy publish (pubsub_test.go:257)
+
+    # Reference skips {2,5,6}: 2 is the killed node; 5/6 because Go map
+    # iteration randomizes which grandchild hangs below it.  Our build is
+    # deterministic, so the loss set is a subset of the reference's.
+    check_system(topic, subchs, {2, 5, 6}, 1)
+
+    settle_and_clear(net, subchs)
+    for i in range(10):
+        check_system(topic, subchs, {2}, i + 100)
+
+
+def test_nodes_dropping_gracefully():
+    """``TestNodesDroppingGracefully`` (pubsub_test.go:281-325): subchs[0]
+    parts; only the departed node misses messages, before and after, and its
+    children are re-homed without extra loss."""
+    net = SimNetwork(SimParams(max_peers=8))
+    hosts = net.make_hosts(4)
+    topic, _, subchs = init_pubsub(net, hosts)
+
+    check_system(topic, subchs, None, 0)
+
+    subchs[0].close()  # graceful Part (pubsub_test.go:301)
+    net.step(8)
+
+    check_system(topic, subchs, {0}, 1)
+
+    settle_and_clear(net, subchs)
+    for i in range(10):
+        check_system(topic, subchs, {0}, i + 100)
+
+
+# ---------------------------------------------------------------------------
+# Beyond-reference coverage (SURVEY.md §4 gaps)
+# ---------------------------------------------------------------------------
+
+def test_exact_fifo_order_per_subscriber():
+    """Sequential publishes arrive in order at every subscriber (implicit in
+    the reference's sequential checkSystem loop)."""
+    net = SimNetwork(SimParams(max_peers=8))
+    hosts = net.make_hosts(5)
+    topic, _, subchs = init_pubsub(net, hosts)
+    n = 8
+    for i in range(n):
+        topic.publish_message(f"m{i}".encode())
+    for ch in subchs:
+        got = [ch.get() for _ in range(n)]
+        assert got == [f"m{i}".encode() for i in range(n)]
+
+
+def test_larger_tree_all_deliver():
+    """32-node tree (reference never tests >8)."""
+    net = SimNetwork(SimParams(max_peers=40))
+    hosts = net.make_hosts(32)
+    topic, _, subchs = init_pubsub(net, hosts)
+    for i in range(3):
+        check_system(topic, subchs, None, i)
+
+
+def test_multi_topic_independent_trees():
+    """Two topics with different roots coexist (reference gap: multi-topic)."""
+    net = SimNetwork(SimParams(max_peers=8))
+    hosts = net.make_hosts(4)
+    tms = [TopicManager(h) for h in hosts]
+    t_a = tms[0].new_topic("alpha")
+    t_b = tms[1].new_topic("beta")
+    subs_a = [tms[i].subscribe(hosts[0].id, "alpha") for i in (1, 2, 3)]
+    subs_b = [tms[i].subscribe(hosts[1].id, "beta") for i in (0, 2, 3)]
+    t_a.publish_message(b"on-alpha")
+    t_b.publish_message(b"on-beta")
+    assert all(s.get() == b"on-alpha" for s in subs_a)
+    assert all(s.get() == b"on-beta" for s in subs_b)
+
+
+def test_custom_tree_opts_widths():
+    """Per-topic TreeOpts override (pubsub.go:66-72) shapes the tree."""
+    net = SimNetwork(SimParams(max_peers=16, max_width=8))
+    hosts = net.make_hosts(6)
+    tms = [TopicManager(h) for h in hosts]
+    topic = tms[0].new_topic("wide", TreeOpts(tree_width=5, tree_max_width=8))
+    subs = [tm.subscribe(hosts[0].id, "wide") for tm in tms[1:]]
+    # Width 5 root: all 5 subscribers should be direct children.
+    eng = net.engines[topic.protoid]
+    import numpy as np
+    assert int(np.sum(np.asarray(eng.state.children[0]) >= 0)) == 5
+    check_system(topic, subs, None, 0)
+
+
+def test_repair_timeout_rejoins_at_root():
+    """The reference panics when repair never arrives (client.go:96-98).
+    Here the orphan rejoins at the root after the step-budget timeout —
+    documented deviation SURVEY.md §2.4.8."""
+    params = SimParams(max_peers=8, repair_timeout_steps=8)
+    net = SimNetwork(params)
+    hosts = net.make_hosts(4)
+    topic, _, subchs = init_pubsub(net, hosts)
+    check_system(topic, subchs, None, 0)
+    # Kill hosts[1] but publish nothing: the write-error repair path never
+    # fires, so its child must eventually self-rescue via the watchdog.
+    hosts[1].close()
+    net.step(128)
+    check_system(topic, subchs, {0}, 1)
+
+
+def test_killed_subscriber_times_out():
+    """Reading from a killed subscriber raises the timeout, mirroring the 5 s
+    test timeout firing for a dead peer."""
+    net = SimNetwork(SimParams(max_peers=8))
+    hosts = net.make_hosts(4)
+    topic, _, subchs = init_pubsub(net, hosts)
+    hosts[1].close()
+    topic.publish_message(b"x")
+    with pytest.raises(TimeoutError_):
+        subchs[0].get(step_budget=32)

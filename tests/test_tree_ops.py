@@ -1,0 +1,155 @@
+"""Unit tests for the array kernels underneath the overlay engine."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from go_libp2p_pubsub_tpu.config import SimParams, TreeOpts
+from go_libp2p_pubsub_tpu.ops import tree as tree_ops
+from go_libp2p_pubsub_tpu.ops.graphs import (
+    masked_argmin,
+    nth_free_slot,
+    safe_gather,
+    segment_rank,
+)
+
+
+def test_segment_rank_orders_within_target():
+    targets = jnp.array([3, 1, 3, 3, 1, 0], jnp.int32)
+    mask = jnp.array([True, True, True, False, True, True])
+    rank = np.asarray(segment_rank(targets, mask))
+    # Target 3 joiners at indices 0,2 -> ranks 0,1; index 3 masked out.
+    assert rank[0] == 0 and rank[2] == 1
+    # Target 1 joiners at indices 1,4 -> ranks 0,1.
+    assert rank[1] == 0 and rank[4] == 1
+    assert rank[5] == 0
+
+
+def test_masked_argmin_ties_lowest_index():
+    v = jnp.array([[5, 2, 2, 9]], jnp.int32)
+    m = jnp.array([[True, True, True, True]])
+    assert int(masked_argmin(v, m)[0]) == 1
+    m2 = jnp.array([[True, False, True, True]])
+    assert int(masked_argmin(v, m2)[0]) == 2
+
+
+def test_safe_gather_negative_indices():
+    arr = jnp.array([10, 20, 30], jnp.int32)
+    idx = jnp.array([2, -1, 0], jnp.int32)
+    assert np.asarray(safe_gather(arr, idx, -7)).tolist() == [30, -7, 10]
+
+
+def test_safe_gather_2d_rows():
+    arr = jnp.arange(6, dtype=jnp.int32).reshape(3, 2)
+    idx = jnp.array([1, -1], jnp.int32)
+    out = np.asarray(safe_gather(arr, idx, 0))
+    assert out.tolist() == [[2, 3], [0, 0]]
+
+
+def test_nth_free_slot():
+    used = jnp.array([True, False, True, False, False])
+    assert int(nth_free_slot(used, jnp.int32(0))) == 1
+    assert int(nth_free_slot(used, jnp.int32(1))) == 3
+    assert int(nth_free_slot(used, jnp.int32(2))) == 4
+    assert int(nth_free_slot(used, jnp.int32(3))) == 5  # out of slots -> W
+
+
+def _joined_tree(n_sub=3, **kw):
+    params = SimParams(max_peers=kw.pop("max_peers", 8), **kw)
+    st = tree_ops.init_state(params, TreeOpts(), root=0)
+    for p in range(1, n_sub + 1):
+        st = tree_ops.begin_subscribe(st, jnp.int32(p))
+        for _ in range(16):
+            if bool(st.joined[p]):
+                break
+            st = tree_ops.step(st)
+    return st
+
+
+def test_join_walk_respects_width_and_redirects():
+    st = _joined_tree(3)
+    ch0 = np.asarray(st.children[0])
+    # Root width 2: exactly two direct children (peers 1 and 2).
+    assert sorted(c for c in ch0 if c >= 0) == [1, 2]
+    # Peer 3 redirected to the min-size child = peer 1 (tie -> lowest slot).
+    assert int(st.parent[3]) == 1
+
+
+def test_subtree_sizes_are_real():
+    # Deviation from reference bug §2.4.3: sizes reflect actual membership.
+    st = _joined_tree(3)
+    sizes = np.asarray(st.subtree_size)
+    assert sizes[0] == 4  # root counts everyone
+    assert sizes[1] == 2  # peer 1 has child 3
+    assert sizes[2] == 1
+    assert sizes[3] == 1
+
+
+def test_publish_delivers_exactly_once_per_subscriber():
+    st = _joined_tree(3)
+    st = tree_ops.publish(st, jnp.int32(7))
+    for _ in range(6):
+        st = tree_ops.step(st)
+    for p in (1, 2, 3):
+        st, msgs, count = tree_ops.drain_out(st, jnp.int32(p))
+        assert int(count) == 1
+        assert int(msgs[0]) == 7
+    # Root delivers nothing to itself.
+    st, _, count0 = tree_ops.drain_out(st, jnp.int32(0))
+    assert int(count0) == 0
+
+
+def test_backpressure_stalls_when_out_ring_full():
+    params = SimParams(max_peers=4, out_cap=2, queue_cap=8)
+    st = tree_ops.init_state(params, TreeOpts(), root=0)
+    st = tree_ops.begin_subscribe(st, jnp.int32(1))
+    for _ in range(8):
+        st = tree_ops.step(st)
+    assert bool(st.joined[1])
+    for m in range(4):
+        st = tree_ops.publish(st, jnp.int32(m))
+    for _ in range(12):
+        st = tree_ops.step(st)
+    # Undrained subscriber: only out_cap messages delivered, rest queued.
+    assert int(st.out_len[1]) == 2
+    assert int(st.q_len[1]) >= 1
+    # Draining releases the backlog.
+    st, msgs, count = tree_ops.drain_out(st, jnp.int32(1))
+    assert int(count) == 2
+    for _ in range(8):
+        st = tree_ops.step(st)
+    assert int(st.out_len[1]) == 4
+
+
+def test_abrupt_kill_detected_on_forward_then_repaired():
+    st = _joined_tree(3)  # 0 -> {1 -> {3}, 2}
+    st = tree_ops.kill_peer(st, jnp.int32(1))
+    st = tree_ops.publish(st, jnp.int32(0))
+    for _ in range(8):
+        st = tree_ops.step(st)
+    # Message 0 lost below the dead node; peer 2 still got it.
+    st, _, c2 = tree_ops.drain_out(st, jnp.int32(2))
+    assert int(c2) == 1
+    st, _, c3 = tree_ops.drain_out(st, jnp.int32(3))
+    assert int(c3) == 0
+    # Orphan 3 re-homed under the detecting grandparent (the root).
+    assert int(st.parent[3]) == 0
+    assert not bool(st.joined[1])
+    # Subsequent traffic reaches 3.
+    st = tree_ops.publish(st, jnp.int32(1))
+    for _ in range(6):
+        st = tree_ops.step(st)
+    st, msgs, c3b = tree_ops.drain_out(st, jnp.int32(3))
+    assert int(c3b) == 1 and int(msgs[0]) == 1
+
+
+def test_graceful_part_loses_nothing():
+    st = _joined_tree(3)  # 0 -> {1 -> {3}, 2}
+    st = tree_ops.leave_peer(st, jnp.int32(1))
+    st = tree_ops.publish(st, jnp.int32(0))
+    for _ in range(8):
+        st = tree_ops.step(st)
+    for p in (2, 3):
+        st, msgs, count = tree_ops.drain_out(st, jnp.int32(p))
+        assert int(count) == 1, f"peer {p} lost the message"
+    assert int(st.parent[3]) == 0  # adopted by leaver's parent
+    assert not bool(st.alive[1])

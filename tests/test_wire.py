@@ -1,0 +1,114 @@
+"""Wire codec parity tests.
+
+Golden byte strings below are what Go's ``encoding/json`` produces for the
+reference ``Message`` struct (``/root/reference/pubsub.go:122-153``): compact
+separators, ``[]byte`` as base64, ``Peers`` under json key ``"parents"``,
+``omitempty`` on everything but ``Type``, trailing newline from
+``json.Encoder``.
+"""
+
+import pytest
+
+from go_libp2p_pubsub_tpu.wire import (
+    Message,
+    MessageDecoder,
+    MessageType,
+    decode_message,
+    encode_message,
+)
+
+
+def test_message_type_values():
+    # pubsub.go:138-144: Data=0, Join=1, Part=2, Update=3, State=4
+    assert MessageType.DATA == 0
+    assert MessageType.JOIN == 1
+    assert MessageType.PART == 2
+    assert MessageType.UPDATE == 3
+    assert MessageType.STATE == 4
+
+
+def test_golden_join():
+    # Go: Message{Type: Join} -> {"Type":1}
+    assert encode_message(Message(type=MessageType.JOIN)) == b'{"Type":1}\n'
+
+
+def test_golden_data_base64():
+    # Go marshals []byte("hi") as base64 "aGk="
+    m = Message(type=MessageType.DATA, data=b"hi")
+    assert encode_message(m) == b'{"Type":0,"data":"aGk="}\n'
+
+
+def test_golden_welcome_update():
+    # The welcome written by handleJoin (subtree.go:121-128).
+    m = Message(
+        type=MessageType.UPDATE,
+        peers=["QmPeer"],
+        tree_width=2,
+        tree_max_width=5,
+    )
+    assert (
+        encode_message(m)
+        == b'{"Type":3,"parents":["QmPeer"],"treewidth":2,"treemaxwidth":5}\n'
+    )
+
+
+def test_golden_state_notify():
+    # The upward State notify (subtree.go:137-146).
+    m = Message(type=MessageType.STATE, peers=["QmChild"], num_peers=3)
+    assert encode_message(m) == b'{"Type":4,"parents":["QmChild"],"numpeers":3}\n'
+
+
+def test_golden_part():
+    assert encode_message(Message(type=MessageType.PART)) == b'{"Type":2}\n'
+
+
+def test_omitempty_zero_values():
+    # Zero-valued omitempty fields must vanish, like Go's omitempty.
+    m = Message(type=MessageType.DATA, data=b"", peers=[], tree_width=0, num_peers=0)
+    assert encode_message(m) == b'{"Type":0}\n'
+
+
+@pytest.mark.parametrize(
+    "m",
+    [
+        Message(),
+        Message(type=MessageType.DATA, data=b"\x00\xffbinary\n"),
+        Message(type=MessageType.UPDATE, peers=["a", "b"], tree_width=3, tree_max_width=7),
+        Message(type=MessageType.STATE, peers=["x"], num_peers=41),
+        Message(type=MessageType.PART),
+    ],
+)
+def test_roundtrip(m):
+    assert decode_message(encode_message(m)) == m
+
+
+def test_decode_go_style_input():
+    # Go decoder tolerates fields in any order and unknown fields.
+    raw = b'{"data":"aGVsbG8=","Type":0,"unknown":1}'
+    m = decode_message(raw)
+    assert m.type == MessageType.DATA
+    assert m.data == b"hello"
+
+
+def test_streaming_decoder_concatenated_objects():
+    # Framing is raw concatenated JSON objects (pubsub.go:122-134).
+    msgs = [
+        Message(type=MessageType.JOIN),
+        Message(type=MessageType.UPDATE, peers=["p"], tree_width=2, tree_max_width=5),
+        Message(type=MessageType.DATA, data=b"payload"),
+    ]
+    stream = b"".join(encode_message(m) for m in msgs)
+    dec = MessageDecoder()
+    # Feed in awkward chunk sizes to exercise incremental boundaries.
+    for i in range(0, len(stream), 7):
+        dec.feed(stream[i : i + 7])
+    assert list(dec) == msgs
+
+
+def test_streaming_decoder_partial_object_buffers():
+    dec = MessageDecoder()
+    dec.feed(b'{"Type":1')  # incomplete
+    assert dec.next_message() is None
+    dec.feed(b"}")
+    assert dec.next_message() == Message(type=MessageType.JOIN)
+    assert dec.next_message() is None
