@@ -1,0 +1,87 @@
+"""Headline benchmark: message dissemination throughput on device.
+
+Stands up a 1024-peer dissemination tree (the v0 overlay at 128x the
+reference's tested scale), pumps a pipelined batch of publishes through the
+jitted lockstep engine with `lax.scan` (no host round-trips), and reports
+delivered messages/second across all subscribers.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baseline: the reference publishes no numbers (BASELINE.md); the driver's
+north-star target is 1M validated msgs/sec on a v5e-8 (BASELINE.json), so
+vs_baseline = value / 1e6.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from go_libp2p_pubsub_tpu.config import SimParams, TreeOpts
+from go_libp2p_pubsub_tpu.ops import tree as tree_ops
+
+N_PEERS = 1024
+N_MSGS = 128
+BASELINE_MSGS_PER_SEC = 1_000_000.0
+
+
+def build_tree():
+    params = SimParams(max_peers=N_PEERS, max_width=8, queue_cap=192, out_cap=192)
+    st = tree_ops.init_state(params, TreeOpts(), root=0)
+    st = tree_ops.begin_subscribe_many(st, jnp.arange(N_PEERS) > 0)
+    st = tree_ops.run_steps(st, 4 * int(np.ceil(np.log2(N_PEERS))) + 16)
+    joined = int(jax.device_get(st.joined).sum())
+    assert joined == N_PEERS, f"only {joined}/{N_PEERS} joined"
+    return st
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"bench device: {dev.device_kind}", file=sys.stderr)
+
+    st = build_tree()
+    st = tree_ops.publish_many(st, jnp.arange(N_MSGS, dtype=jnp.int32))
+
+    depth_slack = 4 * int(np.ceil(np.log2(N_PEERS)))
+    n_steps = N_MSGS + depth_slack
+
+    rollout = lambda s: tree_ops.run_steps(s, n_steps)
+    warm = rollout(st)  # compile
+    jax.block_until_ready(warm.out_len)
+
+    t0 = time.perf_counter()
+    out = rollout(st)
+    jax.block_until_ready(out.out_len)
+    dt = time.perf_counter() - t0
+
+    delivered = int(jax.device_get(out.out_len).sum())
+    expected = N_MSGS * (N_PEERS - 1)
+    assert delivered == expected, f"delivered {delivered}, expected {expected}"
+
+    value = delivered / dt
+    print(
+        f"{delivered} deliveries in {dt*1e3:.1f} ms "
+        f"({n_steps} steps, {N_PEERS} peers, {N_MSGS} msgs)",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "treecast_delivered_msgs_per_sec",
+                "value": round(value, 1),
+                "unit": "msgs/sec",
+                "vs_baseline": round(value / BASELINE_MSGS_PER_SEC, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -134,6 +134,37 @@ def begin_subscribe(st: TreeState, peer: jax.Array) -> TreeState:
 
 
 @jax.jit
+def begin_subscribe_many(st: TreeState, peers_mask: jax.Array) -> TreeState:
+    """Start the join walk for every masked peer at once.
+
+    Concurrent joiners are legal — phase B serializes them by segment rank the
+    way the reference serializes under ``chlock``.  This is the batched form
+    used to stand up large trees in O(depth) steps instead of O(N) subscribes.
+    """
+    new = peers_mask & ~st.joined
+    return st._replace(
+        alive=st.alive | peers_mask,
+        join_target=jnp.where(new, st.root, st.join_target),
+        join_prio=jnp.where(new, False, st.join_prio),
+        join_wait=jnp.where(new, 0, st.join_wait),
+    )
+
+
+@jax.jit
+def publish_many(st: TreeState, msg_ids: jax.Array) -> TreeState:
+    """Enqueue a batch of messages at the root (ids >= 0; NO_MSG entries
+    skipped).  Caller is responsible for queue capacity."""
+    r = st.root
+    qcap = st.q.shape[1]
+    valid = msg_ids >= 0
+    offsets = jnp.cumsum(valid.astype(jnp.int32)) - 1
+    tails = (st.q_head[r] + st.q_len[r] + offsets) % qcap
+    rows = jnp.where(valid, r, st.q.shape[0])
+    q = st.q.at[rows, tails].set(msg_ids, mode="drop")
+    return st._replace(q=q, q_len=st.q_len.at[r].add(valid.sum().astype(jnp.int32)))
+
+
+@jax.jit
 def kill_peer(st: TreeState, peer: jax.Array) -> TreeState:
     """Abrupt death — no Part is sent (TestNodesDropping's ``hosts[1].Close()``).
 
@@ -291,15 +322,26 @@ def _phase_join(st: TreeState) -> TreeState:
     join_prio = jnp.where(admitted, False, st.join_prio)
     join_wait = jnp.where(admitted, 0, st.join_wait)
 
-    # --- redirects -> hop to min-subtree-size live child of the target.
+    # --- redirects -> hop to a min-subtree-size live child of the target.
+    # The reference increments the chosen child's size per redirect under
+    # chlock (subtree.go:176-178) so consecutive redirects spread; the array
+    # equivalent is round-robin by redirect rank over the target's children in
+    # ascending-size order.  A lone (sequential) joiner lands exactly on the
+    # min-size child, matching the reference's serialized behavior.
     redirected = joiner & ~admitted
+    redir_rank = segment_rank(target, redirected)
     t_children = st.children[jnp.clip(target, 0, n - 1)]          # i32[N, W]
     t_ch_live = safe_gather(st.alive & st.joined, t_children.reshape(-1), False).reshape(n, w)
     t_ch_live &= t_children >= 0
     t_ch_size = safe_gather(st.subtree_size, t_children.reshape(-1), 0).reshape(n, w)
     has_live_child = t_ch_live.any(axis=1)
-    best = masked_argmin(t_ch_size, t_ch_live)
-    redir_to = jnp.take_along_axis(t_children, best[:, None], axis=1)[:, 0]
+    n_live = t_ch_live.sum(axis=1).astype(jnp.int32)
+    # Order slots by (size, slot) with dead slots pushed last.
+    sort_key = jnp.where(t_ch_live, t_ch_size * w + jnp.arange(w), jnp.int32(2**30))
+    slot_order = jnp.argsort(sort_key, axis=1)                    # i32[N, W]
+    pick = redir_rank % jnp.maximum(n_live, 1)
+    chosen_slot = jnp.take_along_axis(slot_order, pick[:, None], axis=1)[:, 0]
+    redir_to = jnp.take_along_axis(t_children, chosen_slot[:, None], axis=1)[:, 0]
     # No live child to redirect to (the reference's nil-deref case,
     # subtree.go:172-176): retry the same target next step.
     join_target = jnp.where(redirected & has_live_child, redir_to, join_target)
@@ -454,3 +496,26 @@ def step(st: TreeState, size_iters: int = 0, repair_timeout_steps: int = 64) -> 
     st = _phase_repair(st, dead_detect)
     st = _phase_sizes(st, size_iters)
     return st._replace(step_num=st.step_num + 1)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_steps", "size_iters", "repair_timeout_steps")
+)
+def run_steps(
+    st: TreeState,
+    n_steps: int,
+    size_iters: int = 0,
+    repair_timeout_steps: int = 64,
+) -> TreeState:
+    """Advance ``n_steps`` lockstep rounds inside one XLA program.
+
+    ``lax.scan`` keeps the whole rollout on device — no per-step host
+    dispatch — which is how throughput benchmarks and long simulations should
+    drive the engine.
+    """
+
+    def body(s, _):
+        return step(s, size_iters, repair_timeout_steps), None
+
+    st, _ = jax.lax.scan(body, st, None, length=n_steps)
+    return st

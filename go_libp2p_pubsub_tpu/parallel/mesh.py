@@ -1,0 +1,89 @@
+"""Device meshes and shardings for peer-dimension parallelism.
+
+The reference scales by tree depth over OS processes connected by libp2p
+streams (``SURVEY.md`` §5.7/§5.8).  The TPU-native scaling axis is the **peer
+dimension of the state arrays**: shard every per-peer tensor across an ICI
+mesh with ``jax.sharding.NamedSharding`` and let XLA insert the collectives
+(gathers/scatters across shards become all-gathers/all-to-alls on ICI).  No
+sockets; "streams" are array writes.
+
+Works identically on a real TPU slice and on the virtual
+``--xla_force_host_platform_device_count`` CPU mesh used by tests and the
+driver's multi-chip dry run.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PEER_AXIS = "peers"
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = PEER_AXIS) -> Mesh:
+    """1-D device mesh over the first ``n_devices`` devices.
+
+    Falls back to the host CPU backend (virtual devices under
+    ``--xla_force_host_platform_device_count``) when the default platform has
+    fewer devices than requested — the single-real-chip dev loop.
+    """
+    devs: Sequence = jax.devices()
+    if n_devices is None:
+        n_devices = len(devs)
+    if n_devices > len(devs):
+        try:
+            cpu = jax.devices("cpu")
+        except RuntimeError:
+            cpu = []
+        if len(cpu) >= n_devices:
+            devs = cpu
+        else:
+            raise ValueError(
+                f"asked for {n_devices} devices, have {len(devs)} "
+                f"(default) and {len(cpu)} (cpu)"
+            )
+    return Mesh(np.array(devs[:n_devices]), (axis,))
+
+
+def peer_dim_spec(x: Any, axis: str = PEER_AXIS) -> P:
+    """PartitionSpec for one state leaf: shard dim 0 (the peer dim) when it
+    exists, replicate scalars."""
+    ndim = getattr(x, "ndim", 0)
+    if ndim == 0:
+        return P()
+    return P(axis, *([None] * (ndim - 1)))
+
+
+def state_shardings(state: Any, mesh: Mesh, axis: str = PEER_AXIS):
+    """NamedSharding pytree matching ``state``: peer-dim arrays sharded,
+    scalars replicated.  Peer-dim sizes must divide the mesh size."""
+    n = mesh.shape[axis]
+
+    def one(x):
+        spec = peer_dim_spec(x, axis)
+        if getattr(x, "ndim", 0) >= 1 and x.shape[0] % n != 0:
+            raise ValueError(
+                f"peer dim {x.shape[0]} not divisible by mesh axis size {n}"
+            )
+        return NamedSharding(mesh, spec)
+
+    return jax.tree.map(one, state)
+
+
+def shard_state(state: Any, mesh: Mesh, axis: str = PEER_AXIS):
+    """Place a host/single-device state onto the mesh, peer-dim sharded."""
+    return jax.device_put(state, state_shardings(state, mesh, axis))
+
+
+def sharded_fn(fn, mesh: Mesh, example_state: Any, axis: str = PEER_AXIS, **jit_kw):
+    """jit ``fn(state) -> state`` with peer-sharded in/out shardings pinned.
+
+    XLA GSPMD partitions the gathers/scatters of the step function across the
+    mesh, inserting ICI collectives where peers on different shards exchange
+    messages — the array analog of cross-host streams riding the network.
+    """
+    sh = state_shardings(example_state, mesh, axis)
+    return jax.jit(fn, in_shardings=(sh,), out_shardings=sh, **jit_kw)

@@ -1,18 +1,24 @@
 """Test harness config: run on a virtual 8-device CPU mesh.
 
-Must set platform env vars before jax is first imported anywhere in the test
-process; pytest loads conftest before collecting test modules, so this is the
-place.  Multi-chip sharding tests rely on the 8 virtual devices.
+Env vars must be set before the first jax backend initialization.  This
+container's sitecustomize pins ``JAX_PLATFORMS=axon`` (the tunneled TPU), so
+the env var alone is not enough — we also override the jax config, which wins
+at backend-init time.  Multi-chip sharding tests rely on the 8 virtual CPU
+devices.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
