@@ -145,14 +145,18 @@ class ScoreParams:
     first_message_deliveries_weight: float = 1.0
     first_message_deliveries_decay: float = 0.5
     first_message_deliveries_cap: float = 2000.0
-    # P3: mesh message delivery deficit (squared)
-    mesh_message_deliveries_weight: float = -1.0
+    # P3: mesh message delivery deficit (squared).  The threshold must be
+    # tuned to the topic's expected message rate, so P3/P3b default to
+    # DISABLED (weight 0) — a quiet topic with a naive threshold would
+    # mass-prune its own mesh.  Throughput/attack configs enable them with a
+    # rate-appropriate threshold (> 0 is enforced when enabled).
+    mesh_message_deliveries_weight: float = 0.0
     mesh_message_deliveries_decay: float = 0.5
     mesh_message_deliveries_threshold: float = 20.0
     mesh_message_deliveries_cap: float = 100.0
     mesh_message_deliveries_activation_s: float = 5.0
     # P3b: mesh failure penalty (sticky)
-    mesh_failure_penalty_weight: float = -1.0
+    mesh_failure_penalty_weight: float = 0.0
     mesh_failure_penalty_decay: float = 0.5
     # P4: invalid messages (squared)
     invalid_message_deliveries_weight: float = -1.0
@@ -178,6 +182,19 @@ class ScoreParams:
     decay_interval_s: float = 1.0
     decay_to_zero: float = 0.01
     retain_score_s: float = 3600.0
+
+    def __post_init__(self) -> None:
+        # Mirrors the upstream GossipSub validation: an enabled P3 with a
+        # non-positive threshold is a misconfiguration (every mesh link would
+        # carry a penalty regardless of behavior).
+        if (
+            self.mesh_message_deliveries_weight != 0.0
+            and self.mesh_message_deliveries_threshold <= 0.0
+        ):
+            raise ValueError(
+                "mesh_message_deliveries_threshold must be > 0 when "
+                "mesh_message_deliveries_weight is non-zero"
+            )
 
 
 def to_dict(cfg: Any) -> Dict[str, Any]:

@@ -1,0 +1,101 @@
+"""FloodSub — the dense baseline model.
+
+Floods every message over every connection edge (no mesh, no gossip): the
+protocol family the reference's README situates itself in ("a basic one to
+many pubsub implementation", ``README.md:8``) and the first BASELINE.json
+config ("in-process 10-peer floodsub broadcast").  Serves as the delivery
+upper bound / bandwidth worst case against which GossipSub's mesh is judged.
+
+State is a strict subset of the GossipSub layout (same adjacency form), and
+the step is one gather-or per round — the simplest possible epidemic kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.graphs import safe_gather
+from .gossipsub import build_topology
+
+
+class FloodState(NamedTuple):
+    nbrs: jax.Array        # i32[N, K]
+    nbr_valid: jax.Array   # bool[N, K]
+    alive: jax.Array       # bool[N]
+    have: jax.Array        # bool[N, M]
+    fresh: jax.Array       # bool[N, M]
+    first_step: jax.Array  # i32[N, M]
+    msg_valid: jax.Array   # bool[M]
+    msg_birth: jax.Array   # i32[M]
+    step: jax.Array
+
+
+class FloodSub:
+    def __init__(self, n_peers: int = 1024, n_slots: int = 32,
+                 conn_degree: int = 16, msg_window: int = 128):
+        self.n, self.k, self.m = n_peers, n_slots, msg_window
+        self.conn_degree = conn_degree
+
+    def init(self, seed: int = 0) -> FloodState:
+        rng = np.random.default_rng(seed)
+        nbrs, _, valid = build_topology(rng, self.n, self.k, self.conn_degree)
+        n, m = self.n, self.m
+        return FloodState(
+            nbrs=jnp.asarray(nbrs, jnp.int32),
+            nbr_valid=jnp.asarray(valid),
+            alive=jnp.ones((n,), bool),
+            have=jnp.zeros((n, m), bool),
+            fresh=jnp.zeros((n, m), bool),
+            first_step=jnp.full((n, m), -1, jnp.int32),
+            msg_valid=jnp.zeros((m,), bool),
+            msg_birth=jnp.zeros((m,), jnp.int32),
+            step=jnp.asarray(0, jnp.int32),
+        )
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def publish(self, st: FloodState, src, slot, valid) -> FloodState:
+        clear = jnp.zeros((self.n,), bool)
+        return st._replace(
+            have=st.have.at[:, slot].set(clear).at[src, slot].set(True),
+            fresh=st.fresh.at[:, slot].set(clear).at[src, slot].set(True),
+            first_step=st.first_step.at[:, slot].set(-1).at[src, slot].set(st.step),
+            msg_valid=st.msg_valid.at[slot].set(valid),
+            msg_birth=st.msg_birth.at[slot].set(st.step),
+        )
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def step(self, st: FloodState) -> FloodState:
+        """Flood round: every peer relays last round's receipts on ALL edges."""
+        n = self.n
+        j = jnp.clip(st.nbrs, 0, n - 1)
+        edge_ok = st.nbr_valid & safe_gather(st.alive, st.nbrs, False)
+        arrived = (edge_ok[:, :, None] & st.fresh[j]).any(axis=1)
+        new = arrived & ~st.have & st.alive[:, None]
+        return st._replace(
+            have=st.have | (new & st.msg_valid[None, :]),
+            fresh=new & st.msg_valid[None, :],
+            first_step=jnp.where(new & (st.first_step < 0), st.step, st.first_step),
+            step=st.step + 1,
+        )
+
+    @functools.partial(jax.jit, static_argnames=("self", "n_steps"))
+    def run(self, st: FloodState, n_steps: int) -> FloodState:
+        def body(s, _):
+            return self.step(s), None
+
+        st, _ = jax.lax.scan(body, st, None, length=n_steps)
+        return st
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def delivery_stats(self, st: FloodState) -> Tuple[jax.Array, jax.Array]:
+        alive_n = jnp.maximum(st.alive.sum(), 1)
+        frac = (st.have & st.alive[:, None]).sum(axis=0) / alive_n
+        lat = jnp.where(st.first_step >= 0,
+                        (st.first_step - st.msg_birth[None, :]).astype(jnp.float32),
+                        jnp.nan)
+        return frac, jnp.nanmedian(lat)

@@ -1,0 +1,298 @@
+"""GossipSub — the scalable mesh model (north-star flagship for scale).
+
+A device-resident GossipSub v1.1-shaped simulator: static neighbor-slot
+adjacency, mesh overlay maintained by heartbeat kernels, eager push + lazy
+IHAVE/IWANT gossip, full peer-score state updated by delivery attribution.
+This is the model behind BASELINE.json configs (b) 1k-peer D=6 heartbeat sim,
+(d) scoring under attack traces, and (e) the 100k-peer ICI-sharded epidemic
+sim (see ``parallel/``).
+
+The v0 reference contains none of this (SURVEY.md §0) — it is the capability
+envelope the framework grows into; the protocol rules follow the public
+GossipSub spec, with the simplifications documented in ``ops/gossip.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import GossipSubParams, ScoreParams
+from ..ops import gossip as gossip_ops
+from ..ops import scoring as scoring_ops
+from ..ops.scoring import GlobalCounters, TopicCounters
+
+
+class GossipState(NamedTuple):
+    """Single-topic mesh state.  N peers, K neighbor slots, M message window.
+
+    Multi-topic operation stacks these via ``jax.vmap`` (topology shared,
+    mesh/counters per topic); global score counters live outside the vmap.
+    """
+
+    nbrs: jax.Array        # i32[N, K] connection slots -> remote peer id
+    rev: jax.Array         # i32[N, K] remote's slot index back to me
+    nbr_valid: jax.Array   # bool[N, K]
+    alive: jax.Array       # bool[N]
+    mesh: jax.Array        # bool[N, K] symmetric mesh membership
+    counters: TopicCounters    # per-slot topic score counters
+    gcounters: GlobalCounters  # per-peer global score inputs
+    scores: jax.Array      # f32[N, K] cached neighbor scores (last heartbeat)
+    have: jax.Array        # bool[N, M] possession (seen-cache within window)
+    fresh: jax.Array       # bool[N, M] first-received last round
+    gossip_pend: jax.Array # bool[N, M] IWANT deliveries due next round
+    first_step: jax.Array  # i32[N, M] first-receipt step, -1 = never
+    msg_valid: jax.Array   # bool[M] validation verdict
+    msg_birth: jax.Array   # i32[M] publish step
+    msg_active: jax.Array  # bool[M] within the mcache/gossip window
+    msg_used: jax.Array    # bool[M] ever published (persists until slot reuse)
+    key: jax.Array         # PRNG key
+    step: jax.Array        # i32
+
+
+def build_topology(
+    rng: np.random.Generator, n: int, k: int, degree: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Random ~degree-regular undirected graph in neighbor-slot form.
+
+    Host-side one-time setup (the analog of the test fixtures' full-mesh
+    ``connectUp``, ``pubsub_test.go:37-57``, but sparse).  Returns
+    (nbrs, rev, nbr_valid).
+    """
+    if degree >= k:
+        raise ValueError(f"degree ({degree}) must be < slot count k ({k})")
+    nbrs = np.full((n, k), -1, np.int64)
+    rev = np.full((n, k), -1, np.int64)
+    used = np.zeros(n, np.int64)
+    adj = [set() for _ in range(n)]
+    # Union of `degree` random perfect-matching-ish pairings.
+    for _ in range(degree):
+        perm = rng.permutation(n)
+        for a in range(0, n - 1, 2):
+            i, j = int(perm[a]), int(perm[a + 1])
+            if j in adj[i] or used[i] >= k or used[j] >= k:
+                continue
+            si, sj = used[i], used[j]
+            nbrs[i, si], nbrs[j, sj] = j, i
+            rev[i, si], rev[j, sj] = sj, si
+            adj[i].add(j)
+            adj[j].add(i)
+            used[i] += 1
+            used[j] += 1
+    return nbrs, rev, nbrs >= 0
+
+
+class GossipSub:
+    """Single-topic GossipSub simulator with static shapes."""
+
+    def __init__(
+        self,
+        n_peers: int = 1024,
+        n_slots: int = 32,
+        conn_degree: int = 16,
+        msg_window: int = 128,
+        params: Optional[GossipSubParams] = None,
+        score_params: Optional[ScoreParams] = None,
+        heartbeat_steps: int = 8,
+    ):
+        self.n = n_peers
+        self.k = n_slots
+        self.m = msg_window
+        self.conn_degree = conn_degree
+        self.params = params or GossipSubParams()
+        self.score_params = score_params or ScoreParams()
+        self.heartbeat_steps = heartbeat_steps
+
+    def init(self, seed: int = 0) -> GossipState:
+        rng = np.random.default_rng(seed)
+        nbrs, rev, valid = build_topology(rng, self.n, self.k, self.conn_degree)
+        n, k, m = self.n, self.k, self.m
+        st = GossipState(
+            nbrs=jnp.asarray(nbrs, jnp.int32),
+            rev=jnp.asarray(rev, jnp.int32),
+            nbr_valid=jnp.asarray(valid),
+            alive=jnp.ones((n,), bool),
+            mesh=jnp.zeros((n, k), bool),
+            counters=TopicCounters.zeros(n, k),
+            gcounters=GlobalCounters.zeros(n),
+            scores=jnp.zeros((n, k), jnp.float32),
+            have=jnp.zeros((n, m), bool),
+            fresh=jnp.zeros((n, m), bool),
+            gossip_pend=jnp.zeros((n, m), bool),
+            first_step=jnp.full((n, m), -1, jnp.int32),
+            msg_valid=jnp.zeros((m,), bool),
+            msg_birth=jnp.zeros((m,), jnp.int32),
+            msg_active=jnp.zeros((m,), bool),
+            msg_used=jnp.zeros((m,), bool),
+            key=jax.random.PRNGKey(seed),
+            step=jnp.asarray(0, jnp.int32),
+        )
+        # Converge the mesh before traffic: a few warmup heartbeats.
+        for _ in range(3):
+            st = self._heartbeat(st)
+        return st
+
+    # -- events -------------------------------------------------------------
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def publish(
+        self,
+        st: GossipState,
+        src: jax.Array,
+        slot: jax.Array,
+        valid: jax.Array,
+    ) -> GossipState:
+        """Seed a message at ``src`` in window ``slot`` (recycling the slot).
+
+        ``valid=False`` publishes a message that will fail validation at
+        every receiver — the attack-trace injection point (the reference's
+        missing signature hole, ``pubsub.go:117``, made explicit).
+        """
+        col_clear_n = jnp.zeros((self.n,), bool)
+        return st._replace(
+            have=st.have.at[:, slot].set(col_clear_n).at[src, slot].set(True),
+            fresh=st.fresh.at[:, slot].set(col_clear_n).at[src, slot].set(True),
+            gossip_pend=st.gossip_pend.at[:, slot].set(col_clear_n),
+            first_step=st.first_step.at[:, slot].set(-1).at[src, slot].set(st.step),
+            msg_valid=st.msg_valid.at[slot].set(valid),
+            msg_birth=st.msg_birth.at[slot].set(st.step),
+            msg_active=st.msg_active.at[slot].set(True),
+            msg_used=st.msg_used.at[slot].set(True),
+        )
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def kill_peers(self, st: GossipState, mask: jax.Array) -> GossipState:
+        """Abrupt peer failure (liveness mask); the mesh self-heals at the
+        next heartbeat — the fault-injection hook of the sim."""
+        return st._replace(alive=st.alive & ~mask)
+
+    # -- transition ---------------------------------------------------------
+
+    def _heartbeat(self, st: GossipState) -> GossipState:
+        p, sp = self.params, self.score_params
+        khb, kgossip, knext = jax.random.split(st.key, 3)
+
+        # Advance mesh clocks by one heartbeat interval; decay; re-score.
+        c = scoring_ops.tick_mesh_clocks(st.counters, st.mesh, p.heartbeat_interval_s)
+        c = scoring_ops.decay_topic_counters(c, sp)
+        g = scoring_ops.decay_global_counters(st.gcounters, sp)
+        scores = scoring_ops.neighbor_scores(c, g, st.nbrs, st.nbr_valid, sp)
+
+        new_mesh, grafted, pruned = gossip_ops.heartbeat_mesh(
+            khb, st.mesh, scores, st.nbrs, st.rev, st.nbr_valid, st.alive, p
+        )
+        c = scoring_ops.on_prune(c, pruned, sp)
+        c = scoring_ops.on_graft(c, grafted)
+
+        gossip_pend = st.gossip_pend | gossip_ops.gossip_transfer(
+            kgossip,
+            st.have,
+            new_mesh,
+            st.nbrs,
+            st.nbr_valid,
+            st.alive,
+            scores,
+            st.msg_valid,
+            p,
+            sp.gossip_threshold,
+        )
+
+        # Expire messages out of the mcache history window.
+        expired = st.msg_active & (
+            st.step - st.msg_birth > p.history_length * self.heartbeat_steps
+        )
+        return st._replace(
+            mesh=new_mesh,
+            counters=c,
+            gcounters=g,
+            scores=scores,
+            gossip_pend=gossip_pend & ~expired[None, :],
+            msg_active=st.msg_active & ~expired,
+            key=knext,
+        )
+
+    def _propagate(self, st: GossipState) -> GossipState:
+        # Fold due gossip deliveries into this round's receipts.
+        gossip_new = st.gossip_pend & ~st.have & st.alive[:, None]
+        have = st.have | gossip_new
+        fresh = st.fresh | gossip_new
+        first_step = jnp.where(
+            gossip_new & (st.first_step < 0), st.step, st.first_step
+        )
+
+        out = gossip_ops.propagate(
+            st.mesh,
+            st.nbrs,
+            st.nbr_valid,
+            st.alive,
+            have,
+            fresh,
+            first_step,
+            st.msg_valid & st.msg_active,
+            st.step,
+        )
+        c = st.counters._replace(
+            first_message_deliveries=st.counters.first_message_deliveries
+            + out.fmd_inc,
+            mesh_message_deliveries=st.counters.mesh_message_deliveries
+            + out.mmd_inc,
+            invalid_message_deliveries=st.counters.invalid_message_deliveries
+            + out.invalid_inc,
+        )
+        return st._replace(
+            have=out.have,
+            fresh=out.fresh,
+            first_step=out.first_step,
+            counters=c,
+            gossip_pend=jnp.zeros_like(st.gossip_pend),
+        )
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def step(self, st: GossipState) -> GossipState:
+        """One network round: eager-push propagation, plus heartbeat
+        maintenance every ``heartbeat_steps`` rounds."""
+        st = self._propagate(st)
+        st = jax.lax.cond(
+            (st.step % self.heartbeat_steps) == self.heartbeat_steps - 1,
+            self._heartbeat,
+            lambda s: s,
+            st,
+        )
+        return st._replace(step=st.step + 1)
+
+    @functools.partial(jax.jit, static_argnames=("self", "n_steps"))
+    def run(self, st: GossipState, n_steps: int) -> GossipState:
+        def body(s, _):
+            return self.step(s), None
+
+        st, _ = jax.lax.scan(body, st, None, length=n_steps)
+        return st
+
+    # -- metrics ------------------------------------------------------------
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def delivery_stats(self, st: GossipState):
+        """Per-message delivery fraction and latency percentiles (in rounds).
+
+        The headline metrics of BASELINE.json: delivery parity + p50
+        propagation latency.
+        """
+        alive_n = st.alive.sum()
+        delivered = (st.have & st.alive[:, None]).sum(axis=0)  # i32[M]
+        frac = jnp.where(
+            st.msg_used & st.msg_valid,
+            delivered / jnp.maximum(alive_n, 1),
+            jnp.nan,
+        )
+        lat = jnp.where(
+            st.first_step >= 0, st.first_step - st.msg_birth[None, :], -1
+        )
+        valid_lat = (lat >= 0) & st.msg_used[None, :] & st.msg_valid[None, :]
+        lat_f = jnp.where(valid_lat, lat.astype(jnp.float32), jnp.nan)
+        p50 = jnp.nanmedian(lat_f)
+        p99 = jnp.nanpercentile(lat_f, 99.0)
+        return frac, p50, p99

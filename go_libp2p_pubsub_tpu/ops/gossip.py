@@ -1,0 +1,215 @@
+"""GossipSub mesh kernels: eager push, lazy gossip, heartbeat maintenance.
+
+North-star components (BASELINE.json configs b/e): "GossipSub's heartbeat
+mesh-maintenance and IHAVE/IWANT gossip emission become sparse
+graph-propagation kernels over a device-resident peer x topic adjacency".
+
+Representation: a static neighbor-slot adjacency — ``nbrs`` i32[N, K] maps
+each peer's K connection slots to remote peer ids, ``rev`` i32[N, K] gives the
+remote's slot index pointing back (so edge state can be updated symmetrically
+without searches).  Mesh membership, score counters, and message possession
+are dense masks over those slots — every protocol rule becomes an elementwise
+op + a slot-axis reduction, which is exactly what the VPU wants.
+
+Simplifications vs the full v1.1 protocol, stated explicitly: no PX peer
+exchange, no prune-backoff window, no outbound-degree quota (D_out), and
+IHAVE/IWANT is modeled as one fused heartbeat-time transfer instead of two
+request/response round trips (the extra hop of latency is accounted by
+delivering gossip on the step after the heartbeat).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config import GossipSubParams
+from .graphs import safe_gather
+
+
+class PropagateOut(NamedTuple):
+    have: jax.Array
+    fresh: jax.Array
+    first_step: jax.Array
+    fmd_inc: jax.Array      # f32[N, K] first-delivery increments (valid msgs)
+    mmd_inc: jax.Array      # f32[N, K] mesh-delivery increments
+    invalid_inc: jax.Array  # f32[N, K] invalid first-delivery increments
+
+
+def propagate(
+    mesh: jax.Array,        # bool[N, K] symmetric mesh membership
+    nbrs: jax.Array,        # i32[N, K]
+    nbr_valid: jax.Array,   # bool[N, K]
+    alive: jax.Array,       # bool[N]
+    have: jax.Array,        # bool[N, M]
+    fresh: jax.Array,       # bool[N, M] first-received last round -> forwarded now
+    first_step: jax.Array,  # i32[N, M] step of first receipt, -1 = never
+    msg_valid: jax.Array,   # bool[M] validation verdict per message
+    step: jax.Array,        # i32 current step
+) -> PropagateOut:
+    """One eager-push round: every peer relays last round's first-receipts to
+    its mesh neighbors; receivers validate, deduplicate, attribute delivery
+    credit to the earliest delivering slot, and queue valid messages for
+    relay next round.
+
+    The [N, K, M] incoming tensor is the fused "who sent me what" cube; XLA
+    keeps it in registers/VMEM per tile.  Invalid messages are dropped at
+    validation and NOT relayed (their P4 blame lands on the delivering slot).
+    """
+    n, k = nbrs.shape
+
+    j = jnp.clip(nbrs, 0, n - 1)
+    edge_ok = mesh & nbr_valid & safe_gather(alive, nbrs, False)  # bool[N, K]
+    incoming = edge_ok[:, :, None] & fresh[j]                     # bool[N, K, M]
+
+    arrived = incoming.any(axis=1)                                # bool[N, M]
+    new = arrived & ~have & alive[:, None]
+
+    # First-delivering slot per (peer, msg): the lowest slot among senders.
+    prefix = jnp.cumsum(incoming.astype(jnp.int32), axis=1)
+    first_sender = incoming & (prefix == 1)                       # bool[N, K, M]
+    newly = first_sender & new[:, None, :]
+
+    fmd_inc = (newly & msg_valid[None, None, :]).sum(axis=2).astype(jnp.float32)
+    invalid_inc = (newly & ~msg_valid[None, None, :]).sum(axis=2).astype(jnp.float32)
+    # Mesh-delivery counter counts first + duplicate copies from mesh links.
+    mmd_inc = (incoming & msg_valid[None, None, :]).sum(axis=2).astype(jnp.float32)
+
+    have_next = have | (new & msg_valid[None, :])
+    fresh_next = new & msg_valid[None, :]
+    first_step_next = jnp.where(new & (first_step < 0), step, first_step)
+
+    return PropagateOut(
+        have_next, fresh_next, first_step_next, fmd_inc, mmd_inc, invalid_inc
+    )
+
+
+def gossip_transfer(
+    key: jax.Array,
+    have: jax.Array,        # bool[N, M]
+    mesh: jax.Array,        # bool[N, K]
+    nbrs: jax.Array,
+    nbr_valid: jax.Array,
+    alive: jax.Array,
+    scores: jax.Array,      # f32[N, K] my view of each neighbor slot
+    msg_valid: jax.Array,   # bool[M]
+    p: GossipSubParams,
+    gossip_threshold: float,
+) -> jax.Array:
+    """Heartbeat-time IHAVE/IWANT: each peer advertises its window to
+    ``d_lazy`` random non-mesh neighbors scoring above the gossip threshold;
+    targets pull what they miss.  Returns bool[N, M]: messages to deliver via
+    gossip next round.
+
+    The two-message exchange is fused: target t pulls ``have[i] & ~have[t]``
+    directly.  Only valid messages transfer (invalid ones died at their first
+    validation and were never cached).
+    """
+    n, k = nbrs.shape
+    eligible = (
+        nbr_valid
+        & ~mesh
+        & safe_gather(alive, nbrs, False)
+        & (scores >= gossip_threshold)
+    )
+    # Random top-d_lazy among eligible slots.
+    r = jax.random.uniform(key, (n, k))
+    r = jnp.where(eligible, r, -1.0)
+    thresh = -jnp.sort(-r, axis=1)[:, jnp.minimum(p.d_lazy, k) - 1][:, None]
+    chosen = eligible & (r >= thresh) & (r > 0)
+
+    # Scatter-or into targets: pend[t, m] |= have[i, m] & ~have[t, m].
+    t = jnp.where(chosen, nbrs, n).reshape(-1)                    # i32[N*K]
+    src_have = jnp.repeat(have, k, axis=0)                        # bool[N*K, M]
+    lacks = ~safe_gather(have, jnp.clip(t, 0, n - 1), True)
+    offer = src_have & lacks & (t < n)[:, None] & msg_valid[None, :]
+    pend = jnp.zeros((n + 1, have.shape[1]), jnp.int32)
+    pend = pend.at[t].add(offer.astype(jnp.int32), mode="drop")
+    return pend[:n] > 0
+
+
+def heartbeat_mesh(
+    key: jax.Array,
+    mesh: jax.Array,       # bool[N, K]
+    scores: jax.Array,     # f32[N, K]
+    nbrs: jax.Array,
+    rev: jax.Array,
+    nbr_valid: jax.Array,
+    alive: jax.Array,
+    p: GossipSubParams,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Mesh maintenance: prune negative-score and over-degree links, graft
+    toward D from well-scored candidates, then symmetrize edge state.
+
+    Returns (new_mesh, grafted, pruned) as bool[N, K].
+
+    Desired-set rules (each side computes independently, then edges agree):
+    - drop slots whose score < 0 or whose remote died;
+    - when degree > d_hi: keep the d_score best-scoring plus a random fill
+      back to D (spec's oversubscription rule);
+    - when degree < d_lo: graft random non-mesh candidates with score >= 0
+      up to D.
+    Edge agreement: an existing edge survives only if BOTH sides keep it; a
+    new edge forms if EITHER side grafts and the other side's view of the
+    requester is non-negative (GRAFT accepted) — the array form of
+    unilateral PRUNE / accepted GRAFT.
+    """
+    n, k = nbrs.shape
+    remote_alive = safe_gather(alive, nbrs, False)
+    kmask = nbr_valid & remote_alive
+
+    keep = mesh & kmask & (scores >= 0.0)
+    deg = keep.sum(axis=1)
+
+    kkeep, kgraft = jax.random.split(key)
+
+    # Oversubscription: rank kept slots by score with random tie-break; keep
+    # the d_score best unconditionally, fill the rest randomly to D.
+    noise = jax.random.uniform(kkeep, (n, k), minval=0.0, maxval=1e-3)
+    rank_key = jnp.where(keep, scores + noise, -jnp.inf)
+    order = jnp.argsort(-rank_key, axis=1)                        # best first
+    pos = jnp.zeros((n, k), jnp.int32).at[
+        jnp.arange(n)[:, None], order
+    ].set(jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32), (n, k)))
+    over = deg > p.d_hi
+    keep = keep & jnp.where(over[:, None], pos < p.d, True)
+
+    # Grafting: random eligible non-mesh candidates up to D.
+    deg_now = keep.sum(axis=1)
+    want_more = jnp.maximum(p.d - deg_now, 0)
+    cand = kmask & ~keep & (scores >= 0.0)
+    r = jax.random.uniform(kgraft, (n, k))
+    r = jnp.where(cand, r, -1.0)
+    corder = jnp.argsort(-r, axis=1)
+    cpos = jnp.zeros((n, k), jnp.int32).at[
+        jnp.arange(n)[:, None], corder
+    ].set(jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32), (n, k)))
+    graft = cand & (cpos < want_more[:, None]) & (r > 0)
+
+    # Edge agreement via the reverse index.  For my slot (i, k) pointing at
+    # j = nbrs[i, k], the remote's matching slot is (j, rev[i, k]); indexing
+    # any [N, K] per-slot array at [jidx, ridx] reads the remote's view of
+    # this same edge.
+    jidx = jnp.clip(nbrs, 0, n - 1)
+    ridx = jnp.clip(rev, 0, k - 1)
+    keep_rev = keep[jidx, ridx]
+    graft_rev = graft[jidx, ridx]
+    remote_score_of_me = scores[jidx, ridx]
+
+    # Existing edge survives only if BOTH sides keep it (unilateral PRUNE).
+    survives = mesh & keep & keep_rev
+    # New edge forms if either side grafts and the other accepts (its score
+    # of the requester is non-negative) — accepted GRAFT semantics.
+    forms = ~mesh & (
+        (graft & (remote_score_of_me >= 0.0)) | (graft_rev & (scores >= 0.0))
+    )
+    new_mesh = kmask & (survives | forms)
+    # The rules above are symmetric by construction; enforce exactly anyway
+    # so counter updates can trust mesh[i,k] == mesh[j,rev].
+    new_mesh = new_mesh & new_mesh[jidx, ridx]
+
+    grafted = new_mesh & ~mesh
+    pruned = mesh & ~new_mesh
+    return new_mesh, grafted, pruned

@@ -12,8 +12,10 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-INVALID = jnp.int32(-1)
-BIG_I32 = jnp.int32(2**31 - 1)
+# Plain Python ints: converted inside traced code; creating device arrays at
+# import time would initialize a jax backend as a side effect of `import`.
+INVALID = -1
+BIG_I32 = 2**31 - 1
 
 
 def segment_rank(targets: jax.Array, mask: jax.Array) -> jax.Array:

@@ -1,0 +1,192 @@
+"""Peer-score kernels: the GossipSub v1.1 score function as array programs.
+
+The v0 reference has no scoring (``SURVEY.md`` §0); this implements the
+north-star requirement (BASELINE.json config d: "peer-scoring refresh under
+sybil/eclipse attack traces").  The score function follows the public
+GossipSub v1.1 spec shape: per-topic components P1-P4 computed from
+per-(peer, neighbor-slot) counters, global components P5-P7, with periodic
+counter decay.
+
+Everything is elementwise over ``[N, K]`` (peer x neighbor-slot) or ``[N]``
+arrays — embarrassingly data-parallel, fused by XLA, shardable on the peer
+axis.  The "vmapped per-peer reduction" of the north star is realized as
+vectorized reductions over the slot axis.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config import ScoreParams
+
+
+class TopicCounters(NamedTuple):
+    """Per-(local peer, neighbor slot) counters for one topic.
+
+    ``time_in_mesh`` in score quanta; the delivery counters saturate at their
+    caps; ``mesh_failure_penalty`` is the sticky deficit snapshot taken when a
+    peer is pruned under-threshold.
+    """
+
+    time_in_mesh: jax.Array           # f32[N, K]
+    first_message_deliveries: jax.Array  # f32[N, K]
+    mesh_message_deliveries: jax.Array   # f32[N, K]
+    mesh_failure_penalty: jax.Array      # f32[N, K]
+    invalid_message_deliveries: jax.Array  # f32[N, K]
+    mesh_time_active: jax.Array          # f32[N, K] seconds since graft (gates P3)
+
+    @classmethod
+    def zeros(cls, n: int, k: int) -> "TopicCounters":
+        z = jnp.zeros((n, k), jnp.float32)
+        return cls(z, z, z, z, z, z)
+
+
+class GlobalCounters(NamedTuple):
+    """Per-peer global score inputs (indexed by the *remote* peer id)."""
+
+    app_score: jax.Array          # f32[N] P5 application-specific score
+    ip_group: jax.Array           # i32[N] colocation group id (attack model)
+    behaviour_penalty: jax.Array  # f32[N] P7 counter
+
+    @classmethod
+    def zeros(cls, n: int) -> "GlobalCounters":
+        return cls(
+            jnp.zeros((n,), jnp.float32),
+            jnp.arange(n, dtype=jnp.int32),  # unique groups by default
+            jnp.zeros((n,), jnp.float32),
+        )
+
+
+def topic_score(c: TopicCounters, p: ScoreParams) -> jax.Array:
+    """P1-P4 for one topic -> f32[N, K]: my score of each neighbor slot."""
+    p1 = jnp.minimum(
+        c.time_in_mesh / p.time_in_mesh_quantum_s,
+        p.time_in_mesh_cap,
+    ) * p.time_in_mesh_weight
+
+    p2 = jnp.minimum(
+        c.first_message_deliveries, p.first_message_deliveries_cap
+    ) * p.first_message_deliveries_weight
+
+    # P3: squared deficit below the delivery threshold, only after the
+    # activation window (fresh grafts aren't penalized).
+    active = c.mesh_time_active >= p.mesh_message_deliveries_activation_s
+    capped = jnp.minimum(c.mesh_message_deliveries, p.mesh_message_deliveries_cap)
+    deficit = jnp.maximum(p.mesh_message_deliveries_threshold - capped, 0.0)
+    p3 = jnp.where(active, deficit * deficit, 0.0) * p.mesh_message_deliveries_weight
+
+    p3b = c.mesh_failure_penalty * p.mesh_failure_penalty_weight
+
+    p4 = (
+        c.invalid_message_deliveries * c.invalid_message_deliveries
+    ) * p.invalid_message_deliveries_weight
+
+    topic = (p1 + p2 + p3 + p3b + p4) * p.topic_weight
+    return jnp.minimum(topic, p.topic_score_cap)
+
+
+def colocation_penalty(ip_group: jax.Array, p: ScoreParams) -> jax.Array:
+    """P6 -> f32[N]: squared surplus of peers sharing a colocation group.
+
+    ``segment_sum`` over group ids counts group sizes on device — the sybil
+    detector of the attack benchmarks.
+    """
+    n = ip_group.shape[0]
+    group = ip_group % n  # group ids live in [0, N); callers hash IPs into it
+    counts = jax.ops.segment_sum(
+        jnp.ones((n,), jnp.float32), group, num_segments=n
+    )
+    surplus = jnp.maximum(counts[group] - p.ip_colocation_factor_threshold, 0.0)
+    return surplus * surplus * p.ip_colocation_factor_weight
+
+
+def global_score(g: GlobalCounters, p: ScoreParams) -> jax.Array:
+    """P5 + P6 + P7 -> f32[N], indexed by remote peer id."""
+    p5 = g.app_score * p.app_specific_weight
+    p6 = colocation_penalty(g.ip_group, p)
+    excess = jnp.maximum(g.behaviour_penalty - p.behaviour_penalty_threshold, 0.0)
+    p7 = excess * excess * p.behaviour_penalty_weight
+    return p5 + p6 + p7
+
+
+def neighbor_scores(
+    c: TopicCounters,
+    g: GlobalCounters,
+    nbrs: jax.Array,
+    nbr_valid: jax.Array,
+    p: ScoreParams,
+) -> jax.Array:
+    """Full score of each neighbor slot -> f32[N, K].
+
+    ``nbrs`` i32[N, K] maps slots to remote peer ids; invalid slots score
+    -inf so top-k selections never pick them.
+    """
+    gs = global_score(g, p)  # f32[N] by remote id
+    remote = gs[jnp.clip(nbrs, 0, gs.shape[0] - 1)]
+    total = topic_score(c, p) + remote
+    return jnp.where(nbr_valid, total, -jnp.inf)
+
+
+def decay_topic_counters(c: TopicCounters, p: ScoreParams) -> TopicCounters:
+    """Heartbeat decay (refreshScores analog), with decay-to-zero snapping."""
+
+    def dec(x, rate):
+        x = x * rate
+        return jnp.where(x < p.decay_to_zero, 0.0, x)
+
+    return c._replace(
+        first_message_deliveries=dec(
+            c.first_message_deliveries, p.first_message_deliveries_decay
+        ),
+        mesh_message_deliveries=dec(
+            c.mesh_message_deliveries, p.mesh_message_deliveries_decay
+        ),
+        mesh_failure_penalty=dec(c.mesh_failure_penalty, p.mesh_failure_penalty_decay),
+        invalid_message_deliveries=dec(
+            c.invalid_message_deliveries, p.invalid_message_deliveries_decay
+        ),
+    )
+
+
+def decay_global_counters(g: GlobalCounters, p: ScoreParams) -> GlobalCounters:
+    b = g.behaviour_penalty * p.behaviour_penalty_decay
+    return g._replace(behaviour_penalty=jnp.where(b < p.decay_to_zero, 0.0, b))
+
+
+def on_graft(c: TopicCounters, grafted: jax.Array) -> TopicCounters:
+    """Reset per-slot mesh clocks for newly grafted slots (bool[N, K])."""
+    return c._replace(
+        time_in_mesh=jnp.where(grafted, 0.0, c.time_in_mesh),
+        mesh_time_active=jnp.where(grafted, 0.0, c.mesh_time_active),
+    )
+
+
+def on_prune(
+    c: TopicCounters, pruned: jax.Array, p: ScoreParams
+) -> TopicCounters:
+    """Sticky mesh-failure penalty for slots pruned with a delivery deficit
+    (the spec's P3b), and mesh-clock reset."""
+    active = c.mesh_time_active >= p.mesh_message_deliveries_activation_s
+    capped = jnp.minimum(c.mesh_message_deliveries, p.mesh_message_deliveries_cap)
+    deficit = jnp.maximum(p.mesh_message_deliveries_threshold - capped, 0.0)
+    penalty = jnp.where(pruned & active, deficit * deficit, 0.0)
+    return c._replace(
+        mesh_failure_penalty=c.mesh_failure_penalty + penalty,
+        time_in_mesh=jnp.where(pruned, 0.0, c.time_in_mesh),
+        mesh_time_active=jnp.where(pruned, 0.0, c.mesh_time_active),
+    )
+
+
+def tick_mesh_clocks(
+    c: TopicCounters, in_mesh: jax.Array, dt_s: float | jax.Array
+) -> TopicCounters:
+    """Advance P1 time-in-mesh and the P3 activation clock for mesh slots."""
+    return c._replace(
+        time_in_mesh=jnp.where(in_mesh, c.time_in_mesh + dt_s, c.time_in_mesh),
+        mesh_time_active=jnp.where(
+            in_mesh, c.mesh_time_active + dt_s, c.mesh_time_active
+        ),
+    )

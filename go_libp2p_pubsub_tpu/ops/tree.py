@@ -48,7 +48,7 @@ import jax
 import jax.numpy as jnp
 
 from ..config import SimParams, TreeOpts
-from .graphs import masked_argmin, nth_free_slot, safe_gather, segment_rank
+from .graphs import nth_free_slot, safe_gather, segment_rank
 
 NO_PEER = -1  # empty slot / no parent / no target
 NO_MSG = -1
@@ -336,9 +336,10 @@ def _phase_join(st: TreeState) -> TreeState:
     t_ch_size = safe_gather(st.subtree_size, t_children.reshape(-1), 0).reshape(n, w)
     has_live_child = t_ch_live.any(axis=1)
     n_live = t_ch_live.sum(axis=1).astype(jnp.int32)
-    # Order slots by (size, slot) with dead slots pushed last.
-    sort_key = jnp.where(t_ch_live, t_ch_size * w + jnp.arange(w), jnp.int32(2**30))
-    slot_order = jnp.argsort(sort_key, axis=1)                    # i32[N, W]
+    # Order slots by (size, slot): a stable argsort on masked sizes breaks
+    # ties toward the lowest slot, with dead slots pushed last.
+    sort_key = jnp.where(t_ch_live, t_ch_size, jnp.iinfo(jnp.int32).max)
+    slot_order = jnp.argsort(sort_key, axis=1, stable=True)       # i32[N, W]
     pick = redir_rank % jnp.maximum(n_live, 1)
     chosen_slot = jnp.take_along_axis(slot_order, pick[:, None], axis=1)[:, 0]
     redir_to = jnp.take_along_axis(t_children, chosen_slot[:, None], axis=1)[:, 0]

@@ -1,0 +1,115 @@
+"""Peer-score kernel unit tests (P1-P7, decay, prune penalties)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from go_libp2p_pubsub_tpu.config import ScoreParams
+from go_libp2p_pubsub_tpu.ops.scoring import (
+    GlobalCounters,
+    TopicCounters,
+    decay_topic_counters,
+    global_score,
+    neighbor_scores,
+    on_prune,
+    tick_mesh_clocks,
+    topic_score,
+)
+
+
+def mk(n=4, k=3):
+    return TopicCounters.zeros(n, k), GlobalCounters.zeros(n)
+
+
+def test_p1_time_in_mesh_capped():
+    c, _ = mk()
+    p = ScoreParams(time_in_mesh_weight=0.5, time_in_mesh_cap=10.0)
+    c = c._replace(time_in_mesh=jnp.full((4, 3), 100.0))
+    s = np.asarray(topic_score(c, p))
+    assert np.allclose(s, 5.0)  # capped at 10 * 0.5
+
+
+def test_p2_first_deliveries_positive():
+    c, _ = mk()
+    p = ScoreParams()
+    c = c._replace(first_message_deliveries=jnp.full((4, 3), 7.0))
+    assert np.asarray(topic_score(c, p)).min() > 0
+
+
+def test_p3_deficit_requires_activation_and_traffic_threshold():
+    p = ScoreParams(
+        mesh_message_deliveries_weight=-1.0,
+        mesh_message_deliveries_threshold=10.0,
+        mesh_message_deliveries_activation_s=5.0,
+    )
+    c, _ = mk()
+    # Below activation: no penalty even with zero deliveries.
+    c_fresh = c._replace(mesh_time_active=jnp.full((4, 3), 1.0))
+    assert np.asarray(topic_score(c_fresh, p)).min() == 0.0
+    # Past activation with zero deliveries: squared deficit.
+    c_old = c._replace(mesh_time_active=jnp.full((4, 3), 10.0))
+    s = np.asarray(topic_score(c_old, p))
+    assert np.allclose(s, -100.0)  # (10-0)^2 * -1
+
+
+def test_p4_invalid_squared():
+    c, _ = mk()
+    p = ScoreParams()
+    c = c._replace(invalid_message_deliveries=jnp.full((4, 3), 3.0))
+    assert np.allclose(np.asarray(topic_score(c, p)), -9.0)
+
+
+def test_p5_p7_global():
+    _, g = mk()
+    p = ScoreParams(behaviour_penalty_threshold=2.0)
+    g = g._replace(
+        app_score=jnp.array([5.0, -5.0, 0.0, 0.0]),
+        behaviour_penalty=jnp.array([0.0, 0.0, 6.0, 1.0]),
+    )
+    s = np.asarray(global_score(g, p))
+    assert s[0] == 5.0
+    assert s[1] == -5.0
+    assert s[2] == -16.0  # (6-2)^2 * -1
+    assert s[3] == 0.0    # under threshold
+
+
+def test_decay_snaps_to_zero():
+    c, _ = mk()
+    p = ScoreParams(first_message_deliveries_decay=0.5, decay_to_zero=0.1)
+    c = c._replace(first_message_deliveries=jnp.full((4, 3), 0.15))
+    c = decay_topic_counters(c, p)
+    assert np.asarray(c.first_message_deliveries).max() == 0.0
+
+
+def test_on_prune_sticky_penalty():
+    p = ScoreParams(
+        mesh_message_deliveries_weight=-1.0,
+        mesh_message_deliveries_threshold=4.0,
+        mesh_message_deliveries_activation_s=1.0,
+    )
+    c, _ = mk()
+    c = c._replace(
+        mesh_time_active=jnp.full((4, 3), 2.0),
+        mesh_message_deliveries=jnp.full((4, 3), 1.0),
+    )
+    pruned = jnp.zeros((4, 3), bool).at[0, 0].set(True)
+    c2 = on_prune(c, pruned, p)
+    assert float(c2.mesh_failure_penalty[0, 0]) == 9.0  # (4-1)^2
+    assert float(c2.mesh_failure_penalty[1, 1]) == 0.0
+    assert float(c2.time_in_mesh[0, 0]) == 0.0  # clock reset
+
+
+def test_tick_clocks_only_in_mesh():
+    c, _ = mk()
+    mesh = jnp.zeros((4, 3), bool).at[2, 1].set(True)
+    c = tick_mesh_clocks(c, mesh, 1.5)
+    t = np.asarray(c.time_in_mesh)
+    assert t[2, 1] == 1.5 and t.sum() == 1.5
+
+
+def test_neighbor_scores_invalid_slots_neg_inf():
+    c, g = mk()
+    nbrs = jnp.array([[1, 2, -1]] * 4, jnp.int32)
+    valid = jnp.array([[True, True, False]] * 4)
+    s = np.asarray(neighbor_scores(c, g, nbrs, valid, ScoreParams()))
+    assert np.isneginf(s[:, 2]).all()
+    assert np.isfinite(s[:, :2]).all()
