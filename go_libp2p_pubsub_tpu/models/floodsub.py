@@ -32,6 +32,7 @@ class FloodState(NamedTuple):
     first_step: jax.Array  # i32[N, M]
     msg_valid: jax.Array   # bool[M]
     msg_birth: jax.Array   # i32[M]
+    msg_used: jax.Array    # bool[M] ever published
     step: jax.Array
 
 
@@ -54,6 +55,7 @@ class FloodSub:
             first_step=jnp.full((n, m), -1, jnp.int32),
             msg_valid=jnp.zeros((m,), bool),
             msg_birth=jnp.zeros((m,), jnp.int32),
+            msg_used=jnp.zeros((m,), bool),
             step=jnp.asarray(0, jnp.int32),
         )
 
@@ -66,6 +68,7 @@ class FloodSub:
             first_step=st.first_step.at[:, slot].set(-1).at[src, slot].set(st.step),
             msg_valid=st.msg_valid.at[slot].set(valid),
             msg_birth=st.msg_birth.at[slot].set(st.step),
+            msg_used=st.msg_used.at[slot].set(True),
         )
 
     @functools.partial(jax.jit, static_argnums=0)
@@ -93,9 +96,17 @@ class FloodSub:
 
     @functools.partial(jax.jit, static_argnums=0)
     def delivery_stats(self, st: FloodState) -> Tuple[jax.Array, jax.Array]:
+        """Delivery fraction + p50 latency over published VALID messages only
+        (invalid messages stamp first_step at receive-and-reject time and must
+        not pollute the latency median — same masking as GossipSub's stats)."""
         alive_n = jnp.maximum(st.alive.sum(), 1)
-        frac = (st.have & st.alive[:, None]).sum(axis=0) / alive_n
-        lat = jnp.where(st.first_step >= 0,
-                        (st.first_step - st.msg_birth[None, :]).astype(jnp.float32),
-                        jnp.nan)
+        counted = st.msg_used & st.msg_valid
+        frac = jnp.where(
+            counted, (st.have & st.alive[:, None]).sum(axis=0) / alive_n, jnp.nan
+        )
+        lat = jnp.where(
+            (st.first_step >= 0) & counted[None, :],
+            (st.first_step - st.msg_birth[None, :]).astype(jnp.float32),
+            jnp.nan,
+        )
         return frac, jnp.nanmedian(lat)

@@ -1,1 +1,28 @@
-"""Network planes: in-array simulated fabric and the live asyncio host plane."""
+"""Live host plane: asyncio TCP transport + the tree protocol over real
+sockets, byte-compatible with the reference's JSON wire format (SURVEY.md
+§2.2, §5.8).  The in-array simulated fabric lives in ``api.SimNetwork``."""
+
+from .live import (
+    LiveNetwork,
+    LiveSubscription,
+    LiveTopic,
+    LiveTopicManager,
+    SyncHost,
+    SyncSubscription,
+    SyncTopic,
+)
+from .transport import LiveHost, Peerstore, Stream, StreamClosed
+
+__all__ = [
+    "LiveHost",
+    "LiveNetwork",
+    "LiveSubscription",
+    "LiveTopic",
+    "LiveTopicManager",
+    "Peerstore",
+    "Stream",
+    "StreamClosed",
+    "SyncHost",
+    "SyncSubscription",
+    "SyncTopic",
+]

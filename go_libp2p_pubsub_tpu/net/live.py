@@ -1,0 +1,562 @@
+"""Live host plane: the dissemination-tree protocol over real sockets.
+
+This is SURVEY.md §7 step 6 — the DCN-side twin of the device-resident sim
+engine (``ops/tree.py``).  It speaks the byte-compatible JSON wire protocol
+(:mod:`..wire`) over :mod:`.transport` streams and implements the same
+protocol state machine the reference implements with goroutines:
+
+- admit/redirect           ``handleJoin``/``redirectJoin`` (``subtree.go:106-194``)
+- join walk                ``joinToPeer``/``joinParents`` (``subtree.go:196-307``)
+- fan-out                  ``forwardMessage`` (``subtree.go:319-354``) — but
+  concurrent via ``asyncio.gather`` (the reference's ``// TODO: in parallel``,
+  ``subtree.go:325``, done)
+- repair                   ``redistributeChildren`` (``subtree.go:356-375``)
+- receive loop             ``processMessages`` (``client.go:100-132``) with
+  pause/adopt/resume (``client.go:105-122``)
+
+Deliberate deviations from reference bugs (SURVEY.md §2.4), mirrored from the
+sim engine so both planes behave identically:
+
+- §2.4.3  ``State.NumPeers`` carries the *real* subtree size (the reference
+  never increments ``sub.size`` so always reports 0).  The wire formula
+  ``parent_size = NumPeers + 1`` is preserved, so a Go peer interprets our
+  States correctly.
+- §2.4.4  ``State.Peers`` carries the sender's *full* direct-children list
+  (the reference sends only the newest grandchild, so repair loses earlier
+  ones).  A Go parent doing ``c.children = m.Peers`` gets strictly better data.
+- §2.4.5  all-children-dead admits instead of nil-dereferencing.
+- §2.4.6  ``Topic.close_tree`` tears the tree down; plain ``close`` keeps the
+  reference's leaky behavior for parity.
+- §2.4.7  admission is serialized by an asyncio lock on *every* path
+  (the reference skips the lock on the Part-repair path).
+- §2.4.8  repair timeout triggers an implemented rejoin-at-root instead of
+  ``panic("not yet implemented")`` (``client.go:96-98``).
+- §2.4.10 fanout params received in welcomes are validated
+  (``TreeOpts.validated_from_wire``) instead of adopted blind.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..config import SUB_REPAIR_TIMEOUT_S, DELIVERY_BUFFER, TreeOpts
+from ..wire import Message, MessageType
+from .transport import LiveHost, Peerstore, Stream, StreamClosed
+
+MAX_JOIN_HOPS = 64  # bound on the redirect walk (reference: unbounded recursion)
+
+
+@dataclass
+class _Child:
+    """Per-child bookkeeping (``child``, ``subtree.go:36-44``)."""
+
+    stream: Stream
+    size: int = 1              # subtree size incl. the child itself
+    child_ids: List[str] = field(default_factory=list)  # its direct children
+    dead: bool = False
+
+
+class _TreeNode:
+    """Shared subtree state machine for roots and subscribers
+    (``subtree``, ``subtree.go:16-34``)."""
+
+    def __init__(
+        self,
+        host: LiveHost,
+        protoid: str,
+        opts: TreeOpts,
+        repair_timeout_s: float = SUB_REPAIR_TIMEOUT_S,
+    ) -> None:
+        self.host = host
+        self.protoid = protoid
+        self.width = opts.tree_width
+        self.max_width = opts.tree_max_width
+        self.repair_timeout_s = repair_timeout_s
+        self.children: Dict[str, _Child] = {}
+        self.chlock = asyncio.Lock()  # chlock (subtree.go:18) — held on ALL
+        # admission paths, fixing the reference's unlocked Part path (§2.4.7)
+        self.parent_stream: Optional[Stream] = None
+        self.pause: asyncio.Queue = asyncio.Queue(maxsize=4)  # repair handoff
+        self.root_id: Optional[str] = None  # for rejoin-at-root
+        self.closed = False
+
+    # -- accounting ----------------------------------------------------------
+
+    def subtree_size(self) -> int:
+        """Real size of my subtree incl. self (fixes §2.4.3)."""
+        return 1 + sum(c.size for c in self.children.values() if not c.dead)
+
+    def live_child_ids(self) -> List[str]:
+        return [cid for cid, c in self.children.items() if not c.dead]
+
+    async def notify_parent_state(self) -> None:
+        """Upward accounting (``subtree.go:137-146``), with real size and the
+        full children list (§2.4.3/§2.4.4).  ``num_peers`` excludes self so
+        the receiver's ``size = NumPeers + 1`` lands on the true size."""
+        s = self.parent_stream
+        if s is None or s.closed:
+            return
+        try:
+            await s.write_message(
+                Message(
+                    type=MessageType.STATE,
+                    num_peers=self.subtree_size() - 1,
+                    peers=self.live_child_ids(),
+                )
+            )
+        except StreamClosed:
+            pass  # parent death is handled by the read loop
+
+    # -- admission (server side of the join walk) ----------------------------
+
+    async def handle_join(self, s: Stream, prio: bool) -> None:
+        """Admit or redirect a joiner (``handleJoin``, ``subtree.go:106-154``).
+
+        Caller must hold ``chlock`` — enforced by the two call sites
+        (stream handlers and repair), unlike the reference's Part path.
+        """
+        width = self.max_width if prio else self.width
+        live = self.live_child_ids()
+        if len(live) >= width and live:
+            await self._redirect_join(s, live)
+            return
+        # Admit: welcome Update names me as parent + fanout params
+        # (subtree.go:121-128).
+        try:
+            await s.write_message(
+                Message(
+                    type=MessageType.UPDATE,
+                    peers=[self.host.id],
+                    tree_width=self.width,
+                    tree_max_width=self.max_width,
+                )
+            )
+        except StreamClosed:
+            return
+        # Re-admission of an existing child (e.g. its rejoin raced our repair
+        # dial): retire the stale record first so its reader task can't later
+        # evict the fresh one.
+        stale = self.children.pop(s.remote_peer, None)
+        if stale is not None:
+            stale.dead = True
+            stale.stream.close()
+        child = _Child(stream=s)
+        self.children[s.remote_peer] = child
+        self.host.spawn(self._handle_child_messages(s.remote_peer, child))
+        await self.notify_parent_state()
+
+    async def _redirect_join(self, s: Stream, live: List[str]) -> None:
+        """Load-balancing redirect to the min-size live child
+        (``redirectJoin``, ``subtree.go:156-194``)."""
+        minc = min(live, key=lambda cid: self.children[cid].size)
+        # The reference pre-increments the chosen child's size so consecutive
+        # redirects spread (subtree.go:176-178); sizes here are corrected by
+        # the next real State, so the increment is the same heuristic.
+        self.children[minc].size += 1
+        try:
+            await s.write_message(Message(type=MessageType.UPDATE, peers=[minc]))
+        except StreamClosed:
+            pass
+        s.close()
+
+    async def _handle_child_messages(self, cid: str, child: _Child) -> None:
+        """Per-child upward reader (``handleChildMessages``,
+        ``subtree.go:46-76``): State updates accounting, Part (or stream
+        death) triggers redistribution."""
+        try:
+            while True:
+                m = await child.stream.read_message()
+                if m.type == MessageType.STATE:
+                    child.size = m.num_peers + 1  # wire formula (subtree.go:59)
+                    child.child_ids = list(m.peers)
+                    await self.notify_parent_state()
+                elif m.type == MessageType.PART:
+                    await self._drop_child(cid, child)
+                    return
+                # Data/Join/Update from a child are protocol violations; the
+                # reference logs and ignores (subtree.go:71-73).
+        except (StreamClosed, asyncio.CancelledError):
+            if not self.closed and not child.dead:
+                # Abrupt child death seen as read error: repair now instead of
+                # waiting for the next publish's write error.  Same observable
+                # contract (loss windows only shrink).
+                await self._drop_child(cid, child)
+
+    async def _drop_child(self, cid: str, child: _Child) -> None:
+        child.dead = True
+        child.stream.close()
+        # Identity check: only remove/redistribute if this record is still the
+        # current one — a stale reader task must not evict a re-admitted child.
+        if self.children.get(cid) is not child:
+            return
+        del self.children[cid]
+        await self._redistribute(child.child_ids)
+        await self.notify_parent_state()
+
+    async def _redistribute(self, grandchild_ids: List[str]) -> None:
+        """Re-adopt a dead child's children with priority capacity
+        (``redistributeChildren``, ``subtree.go:356-375``) — all of them, not
+        just the newest (§2.4.4)."""
+        for gid in grandchild_ids:
+            if self.closed or gid == self.host.id or gid in self.children:
+                continue
+            try:
+                s = await self.host.new_stream(gid, self.protoid)
+            except (StreamClosed, KeyError):
+                continue  # grandchild also gone; its subtree rejoins via timeout
+            async with self.chlock:
+                # The orphan may have rejoined on its own while we dialed.
+                if self.closed or gid in self.children:
+                    s.close()
+                    continue
+                await self.handle_join(s, prio=True)
+
+    # -- data plane ----------------------------------------------------------
+
+    async def forward_message(self, m: Message) -> None:
+        """Fan out to all live children **concurrently** (``forwardMessage``,
+        ``subtree.go:319-354``, with the ``TODO: in parallel`` done).  Write
+        failures mark children dead; their recorded children are re-adopted."""
+        targets = [(cid, c) for cid, c in self.children.items() if not c.dead]
+        if not targets:
+            return
+
+        async def send(c: _Child):
+            await c.stream.write_message(m)
+
+        results = await asyncio.gather(
+            *(send(c) for _, c in targets), return_exceptions=True
+        )
+        dead = [tc for tc, r in zip(targets, results) if isinstance(r, Exception)]
+        for cid, c in dead:
+            c.dead = True
+            c.stream.close()
+            if self.children.get(cid) is c:  # identity: see _drop_child
+                del self.children[cid]
+        for _, c in dead:
+            await self._redistribute(c.child_ids)
+        if dead:
+            await self.notify_parent_state()
+
+    # -- join walk (client side) ---------------------------------------------
+
+    async def join_to_peer(self, s: Stream) -> Stream:
+        """Dial-side join (``joinToPeer``, ``subtree.go:196-226``): send Join,
+        adopt validated fanout params from the welcome, walk redirects."""
+        await s.write_message(Message(type=MessageType.JOIN))
+        welcome = await s.read_message()
+        if welcome.tree_width and welcome.tree_max_width:
+            # §2.4.10: validate instead of adopting blind (subtree.go:211-213).
+            opts = TreeOpts.validated_from_wire(
+                welcome.tree_width, welcome.tree_max_width
+            )
+            self.width, self.max_width = opts.tree_width, opts.tree_max_width
+        return await self._join_parents(s, welcome, hops=0)
+
+    async def _join_parents(self, s: Stream, welcome: Message, hops: int) -> Stream:
+        """Redirect walk (``joinParents``, ``subtree.go:241-307``): try each
+        candidate parent; a welcome naming the sender means accepted, anything
+        else is a further redirect."""
+        if hops > MAX_JOIN_HOPS:
+            raise StreamClosed("join walk exceeded max hops")
+        last_err: Optional[Exception] = None
+        for cand in welcome.peers:
+            if cand == s.remote_peer:
+                return s  # the sender admitted me: reuse this stream
+            try:
+                cs = await self.host.new_stream(cand, self.protoid)
+                await cs.write_message(Message(type=MessageType.JOIN))
+                w2 = await cs.read_message()
+                if w2.type != MessageType.UPDATE:
+                    cs.close()
+                    continue
+                return await self._join_parents(cs, w2, hops + 1)
+            except (StreamClosed, KeyError) as e:
+                last_err = e
+                continue
+        s.close()
+        raise StreamClosed(f"could not join any candidate parent: {last_err}")
+
+    # -- teardown ------------------------------------------------------------
+
+    async def close(self) -> None:
+        """Graceful leave (``subtree.Close``, ``subtree.go:78-98``): close
+        child streams, Part upstream."""
+        self.closed = True
+        for c in self.children.values():
+            c.stream.close()
+        self.children.clear()
+        s = self.parent_stream
+        if s is not None and not s.closed:
+            try:
+                await s.write_message(Message(type=MessageType.PART))
+            except StreamClosed:
+                pass
+            s.close()
+
+
+class LiveTopic:
+    """Root-side topic over the live plane (``Topic``, ``pubsub.go:33-120``)."""
+
+    def __init__(self, tm: "LiveTopicManager", title: str, opts: TreeOpts):
+        self.tm = tm
+        self.title = title
+        self.protoid = f"{tm.host.id}/{title}"  # (root, title) namespacing
+        self.node = _TreeNode(tm.host, self.protoid, opts)
+        tm.host.set_stream_handler(self.protoid, self._stream_handler)
+
+    async def _stream_handler(self, s: Stream) -> None:
+        """Root inbound streams must open with Join (``pubsub.go:74-92``)."""
+        try:
+            m = await s.read_message()
+        except StreamClosed:
+            return
+        if m.type != MessageType.JOIN:
+            s.close()  # "not a join message" (pubsub.go:81-85)
+            return
+        async with self.node.chlock:  # AddPeer's chlock (pubsub.go:106-108)
+            await self.node.handle_join(s, prio=False)
+
+    async def publish_message(self, data: bytes) -> None:
+        """``PublishMessage`` (``pubsub.go:111-120``).  Signing remains a
+        validator hook (the reference's ``TODO: add signature``); see
+        ``crypto/`` for the batched ed25519 pipeline."""
+        await self.node.forward_message(Message(type=MessageType.DATA, data=data))
+
+    async def close(self) -> None:
+        """Reference-parity close (``pubsub.go:99-103``): unregister only;
+        the tree is leaked exactly as the reference leaks it (§2.4.6)."""
+        self.tm.host.remove_stream_handler(self.protoid)
+        self.tm.topics.pop(self.title, None)
+
+    async def close_tree(self) -> None:
+        """Fixed-semantics close: also tear the subtree down."""
+        await self.close()
+        await self.node.close()
+
+
+class LiveSubscription:
+    """Subscriber session over the live plane (``client``, ``client.go:18-34``)."""
+
+    def __init__(
+        self,
+        tm: "LiveTopicManager",
+        root_id: str,
+        title: str,
+        repair_timeout_s: float,
+        out_buffer: int = DELIVERY_BUFFER,
+    ):
+        self.tm = tm
+        self.protoid = f"{root_id}/{title}"
+        self.node = _TreeNode(
+            tm.host,
+            self.protoid,
+            TreeOpts(),
+            repair_timeout_s=repair_timeout_s,
+        )
+        self.node.root_id = root_id
+        # client.out, cap 16 (client.go:79): a full queue blocks the receive
+        # loop — backpressure by design.
+        self.out: asyncio.Queue = asyncio.Queue(maxsize=out_buffer)
+        self._task: Optional[asyncio.Task] = None
+
+    async def start(self) -> None:
+        """The Subscribe flow (``client.go:65-94``)."""
+        host = self.tm.host
+        s = await host.new_stream(self.node.root_id, self.protoid)
+        host.set_stream_handler(self.protoid, self._stream_handler)
+        self.node.parent_stream = await self.node.join_to_peer(s)
+        await self.node.notify_parent_state()
+        self._task = host.spawn(self._process_messages())
+
+    async def _stream_handler(self, s: Stream) -> None:
+        """Interior-node inbound control (``client.streamHandler``,
+        ``client.go:36-63``): Join -> admit under me; Update -> I was adopted
+        by a repairer, hand the new parent stream to the receive loop."""
+        try:
+            m = await s.read_message()
+        except StreamClosed:
+            return
+        if m.type == MessageType.JOIN:
+            async with self.node.chlock:
+                await self.node.handle_join(s, prio=False)
+        elif m.type == MessageType.UPDATE:
+            try:
+                ns = await self.node._join_parents(s, m, hops=0)
+            except StreamClosed:
+                return
+            await self.node.pause.put(ns)  # sub.pause handoff (client.go:56)
+        else:
+            s.close()
+
+    async def _process_messages(self) -> None:
+        """Receive/relay loop (``processMessages``, ``client.go:100-132``):
+        deliver before forwarding; on parent death pause for repair, and past
+        the deadline rejoin at the root (the reference panics here, §2.4.8)."""
+        node = self.node
+        while not node.closed:
+            try:
+                m = await node.parent_stream.read_message()
+            except StreamClosed:
+                if node.closed:
+                    return
+                node.parent_stream = None
+                try:
+                    node.parent_stream = await asyncio.wait_for(
+                        node.pause.get(), timeout=node.repair_timeout_s
+                    )
+                except asyncio.TimeoutError:
+                    if not await self._rejoin_root():
+                        return
+                await node.notify_parent_state()
+                continue
+            if m.type == MessageType.DATA:
+                await self.out.put(m.data)        # deliver (client.go:124-127)
+                await node.forward_message(m)     # then relay (client.go:130)
+            elif m.type == MessageType.UPDATE:
+                # Unexpected mid-stream Update: ignore (reference logs).
+                continue
+
+    async def _rejoin_root(self) -> bool:
+        """``rejoinRoot`` — implemented (vs ``panic``, ``client.go:96-98``)."""
+        try:
+            s = await self.tm.host.new_stream(self.node.root_id, self.protoid)
+            self.node.parent_stream = await self.node.join_to_peer(s)
+            return True
+        except (StreamClosed, KeyError):
+            self.node.closed = True
+            return False
+
+    async def close(self) -> None:
+        """Graceful leave (``client.Close``, ``client.go:30-34``)."""
+        self.node.closed = True
+        self.tm.host.remove_stream_handler(self.protoid)
+        if self._task is not None:
+            self._task.cancel()
+        await self.node.close()
+
+
+class LiveTopicManager:
+    """Topic registry on one live host (``TopicManager``, ``pubsub.go:19-31``)."""
+
+    def __init__(self, host: LiveHost, repair_timeout_s: float = SUB_REPAIR_TIMEOUT_S):
+        self.host = host
+        self.repair_timeout_s = repair_timeout_s
+        self.topics: Dict[str, LiveTopic] = {}
+
+    async def new_topic(self, title: str, opts: Optional[TreeOpts] = None) -> LiveTopic:
+        t = LiveTopic(self, title, opts or TreeOpts())
+        self.topics[title] = t
+        return t
+
+    async def subscribe(self, root_id: str, title: str) -> LiveSubscription:
+        sub = LiveSubscription(self, root_id, title, self.repair_timeout_s)
+        await sub.start()
+        return sub
+
+
+# ---------------------------------------------------------------------------
+# synchronous facade (one asyncio loop on a background thread)
+# ---------------------------------------------------------------------------
+
+
+class LiveNetwork:
+    """Sync facade over the live plane for tests/tools: one event loop on a
+    daemon thread; the API mirrors the sim plane's ``SimNetwork``."""
+
+    def __init__(self, repair_timeout_s: float = SUB_REPAIR_TIMEOUT_S):
+        self.peerstore = Peerstore()
+        self.repair_timeout_s = repair_timeout_s
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._loop.run_forever, daemon=True)
+        self._thread.start()
+        self._counter = 0
+
+    def call(self, coro, timeout: float = 30.0):
+        return asyncio.run_coroutine_threadsafe(coro, self._loop).result(timeout)
+
+    def host(self) -> "SyncHost":
+        peer_id = f"livepeer-{self._counter}"
+        self._counter += 1
+        h = LiveHost(peer_id, self.peerstore)
+        self.call(h.start())
+        return SyncHost(self, h)
+
+    def make_hosts(self, count: int) -> List["SyncHost"]:
+        return [self.host() for _ in range(count)]
+
+    def shutdown(self) -> None:
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5)
+
+
+class SyncHost:
+    """Sync wrapper over :class:`LiveHost` + its topic manager."""
+
+    def __init__(self, net: LiveNetwork, host: LiveHost):
+        self.net = net
+        self.live = host
+        self.id = host.id
+        self.tm = LiveTopicManager(host, repair_timeout_s=net.repair_timeout_s)
+
+    def new_topic(self, title: str, opts: Optional[TreeOpts] = None) -> "SyncTopic":
+        return SyncTopic(self.net, self.net.call(self.tm.new_topic(title, opts)))
+
+    def subscribe(self, root_id: str, title: str) -> "SyncSubscription":
+        return SyncSubscription(
+            self.net, self.net.call(self.tm.subscribe(root_id, title))
+        )
+
+    def close(self, graceful: bool = False) -> None:
+        """Abrupt kill by default — ``hosts[i].Close()`` in the dropping tests."""
+        self.net.call(self.live.aclose(graceful=graceful))
+
+
+class SyncTopic:
+    def __init__(self, net: LiveNetwork, topic: LiveTopic):
+        self.net = net
+        self.topic = topic
+
+    def publish_message(self, data: bytes) -> None:
+        self.net.call(self.topic.publish_message(data))
+
+    def close(self) -> None:
+        self.net.call(self.topic.close())
+
+    def close_tree(self) -> None:
+        self.net.call(self.topic.close_tree())
+
+
+class SyncSubscription:
+    def __init__(self, net: LiveNetwork, sub: LiveSubscription):
+        self.net = net
+        self.sub = sub
+
+    def get(self, timeout: float = 5.0) -> bytes:
+        """Blocking read under the tests' 5 s deadline (``pubsub_test.go:125``)."""
+
+        async def _get():
+            return await asyncio.wait_for(self.sub.out.get(), timeout)
+
+        return self.net.call(_get(), timeout=timeout + 5)
+
+    def try_get(self) -> Optional[bytes]:
+        async def _try():
+            try:
+                return self.sub.out.get_nowait()
+            except asyncio.QueueEmpty:
+                return None
+
+        return self.net.call(_try())
+
+    def clear(self) -> None:
+        """Drain pending deliveries (``clearWaitingMessages``,
+        ``pubsub_test.go:85-99``)."""
+        while self.try_get() is not None:
+            pass
+
+    def close(self) -> None:
+        self.net.call(self.sub.close())

@@ -108,6 +108,9 @@ def gossip_transfer(
     validation and were never cached).
     """
     n, k = nbrs.shape
+    d_lazy = min(p.d_lazy, k)
+    if d_lazy <= 0:  # gossip disabled (a negative index would wrap: pick all)
+        return jnp.zeros_like(have)
     eligible = (
         nbr_valid
         & ~mesh
@@ -117,7 +120,7 @@ def gossip_transfer(
     # Random top-d_lazy among eligible slots.
     r = jax.random.uniform(key, (n, k))
     r = jnp.where(eligible, r, -1.0)
-    thresh = -jnp.sort(-r, axis=1)[:, jnp.minimum(p.d_lazy, k) - 1][:, None]
+    thresh = -jnp.sort(-r, axis=1)[:, d_lazy - 1][:, None]
     chosen = eligible & (r >= thresh) & (r > 0)
 
     # Scatter-or into targets: pend[t, m] |= have[i, m] & ~have[t, m].
@@ -165,16 +168,26 @@ def heartbeat_mesh(
 
     kkeep, kgraft = jax.random.split(key)
 
-    # Oversubscription: rank kept slots by score with random tie-break; keep
-    # the d_score best unconditionally, fill the rest randomly to D.
+    # Oversubscription: keep the d_score best-scoring slots unconditionally,
+    # fill the remaining D - d_score UNIFORMLY AT RANDOM from the other kept
+    # slots (the spec's rule; pure score-ranking would let an attacker who
+    # inflates P1/P2 deterministically occupy every retained slot — the
+    # eclipse vector the random fill exists to break).
     noise = jax.random.uniform(kkeep, (n, k), minval=0.0, maxval=1e-3)
     rank_key = jnp.where(keep, scores + noise, -jnp.inf)
     order = jnp.argsort(-rank_key, axis=1)                        # best first
     pos = jnp.zeros((n, k), jnp.int32).at[
         jnp.arange(n)[:, None], order
     ].set(jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32), (n, k)))
+    best = keep & (pos < p.d_score)
+    rfill = jnp.where(keep & ~best, noise, -jnp.inf)              # random order
+    rorder = jnp.argsort(-rfill, axis=1)
+    rpos = jnp.zeros((n, k), jnp.int32).at[
+        jnp.arange(n)[:, None], rorder
+    ].set(jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32), (n, k)))
+    fill = keep & ~best & (rpos < max(p.d - p.d_score, 0))
     over = deg > p.d_hi
-    keep = keep & jnp.where(over[:, None], pos < p.d, True)
+    keep = keep & jnp.where(over[:, None], best | fill, True)
 
     # Grafting: random eligible non-mesh candidates up to D.
     deg_now = keep.sum(axis=1)

@@ -24,6 +24,7 @@ here exactly so the live plane (net/live.py) can interoperate with a Go peer:
 from __future__ import annotations
 
 import base64
+import codecs
 import enum
 import json
 from dataclasses import dataclass, field
@@ -119,9 +120,12 @@ class MessageDecoder:
     def __init__(self) -> None:
         self._buf = ""
         self._dec = json.JSONDecoder()
+        # Incremental UTF-8: a multi-byte rune split across socket reads must
+        # buffer, not raise (Go emits non-ASCII peer ids as raw UTF-8).
+        self._utf8 = codecs.getincrementaldecoder("utf-8")()
 
     def feed(self, data: bytes) -> None:
-        self._buf += data.decode()
+        self._buf += self._utf8.decode(data)
 
     def __iter__(self) -> Iterator[Message]:
         return self
