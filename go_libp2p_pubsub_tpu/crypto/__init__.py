@@ -1,0 +1,30 @@
+"""Message authentication: the reference's missing signature layer
+(``// TODO: add signature``, ``/root/reference/pubsub.go:117``), built as
+three interchangeable ed25519 implementations plus a batching pipeline.
+
+- :mod:`.ed25519_ref` — pure-Python oracle (RFC 8032 semantics)
+- :mod:`.native`      — C++ threaded batch verifier (built on demand)
+- :mod:`~..ops.ed25519` — JAX device kernel (TPU batch verifier)
+- :mod:`.pipeline`    — envelopes + batched validation pipeline
+"""
+
+from .ed25519_ref import keypair, public_key, sign, verify
+from .pipeline import (
+    Envelope,
+    ValidationPipeline,
+    sign_envelope,
+    signing_bytes,
+    verify_envelopes,
+)
+
+__all__ = [
+    "Envelope",
+    "ValidationPipeline",
+    "keypair",
+    "public_key",
+    "sign",
+    "sign_envelope",
+    "signing_bytes",
+    "verify",
+    "verify_envelopes",
+]

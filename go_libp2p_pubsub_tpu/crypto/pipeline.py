@@ -1,0 +1,177 @@
+"""Batched message-validation pipeline.
+
+The reference publishes unsigned messages with a ``// TODO: add signature``
+(``/root/reference/pubsub.go:117``) and has no validation anywhere.  This
+module is the framework's answer, shaped for batch throughput rather than
+per-message calls: envelopes accumulate and verify in one shot on the chosen
+backend —
+
+- ``"native"``  — the C++ threaded batch verifier (host data plane default);
+- ``"device"``  — the JAX limb-arithmetic kernel (TPU data plane);
+- ``"python"``  — the pure-Python oracle (tests, last-resort fallback).
+
+Envelope format (this framework's own; the reference has none to mirror):
+the signature covers ``topic_len_u32 || topic || seqno_u64 || payload``, so a
+signature cannot be replayed across topics or sequence numbers.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Callable, List, Literal, Sequence, Tuple
+
+import numpy as np
+
+from . import ed25519_ref
+
+Backend = Literal["native", "device", "python"]
+
+
+def signing_bytes(topic: str, seqno: int, payload: bytes) -> bytes:
+    """The exact byte string a publisher signs (domain-separated by topic and
+    sequence number)."""
+    t = topic.encode()
+    return struct.pack("<I", len(t)) + t + struct.pack("<Q", seqno) + payload
+
+
+@dataclass(frozen=True)
+class Envelope:
+    """A signed message as it travels the wire: payload + authenticator."""
+
+    topic: str
+    seqno: int
+    payload: bytes
+    pubkey: bytes  # 32B ed25519
+    signature: bytes  # 64B
+
+    def to_wire(self) -> bytes:
+        t = self.topic.encode()
+        return (
+            struct.pack("<I", len(t))
+            + t
+            + struct.pack("<Q", self.seqno)
+            + self.pubkey
+            + self.signature
+            + self.payload
+        )
+
+    @classmethod
+    def from_wire(cls, raw: bytes) -> "Envelope":
+        (tlen,) = struct.unpack_from("<I", raw, 0)
+        topic = raw[4 : 4 + tlen].decode()
+        off = 4 + tlen
+        (seqno,) = struct.unpack_from("<Q", raw, off)
+        off += 8
+        pubkey = raw[off : off + 32]
+        signature = raw[off + 32 : off + 96]
+        payload = raw[off + 96 :]
+        return cls(topic, seqno, payload, pubkey, signature)
+
+
+def sign_envelope(seed: bytes, topic: str, seqno: int, payload: bytes) -> Envelope:
+    """Publisher-side signing (via the Python oracle — publishers sign one
+    message at a time; batch signing for load generation lives in
+    ``native.sign_batch``)."""
+    pk = ed25519_ref.public_key(seed)
+    sig = ed25519_ref.sign(seed, signing_bytes(topic, seqno, payload))
+    return Envelope(topic, seqno, payload, pk, sig)
+
+
+def _verify_native(pks, msgs, sigs) -> np.ndarray:
+    from . import native
+
+    return native.verify_batch(pks, msgs, sigs)
+
+
+def _verify_device(pks, msgs, sigs) -> np.ndarray:
+    from ..ops import ed25519 as dev
+
+    return dev.verify_batch(pks, msgs, sigs)
+
+
+def _verify_python(pks, msgs, sigs) -> np.ndarray:
+    return np.array(
+        [ed25519_ref.verify(p, m, s) for p, m, s in zip(pks, msgs, sigs)], bool
+    )
+
+
+_BACKENDS: dict = {
+    "native": _verify_native,
+    "device": _verify_device,
+    "python": _verify_python,
+}
+
+
+class ValidationPipeline:
+    """Accumulate envelopes, verify in batches, deliver verdicts.
+
+    The structural replacement for the reference's (absent) per-message
+    validation hook: producers ``submit`` envelopes, the owner calls
+    ``flush()`` at its cadence (heartbeat, step boundary, or queue-depth
+    trigger), and verdicts come back as (envelope, bool) pairs in submit
+    order.  Batching is the whole point: signature verification amortizes
+    across the batch on every backend.
+    """
+
+    def __init__(
+        self,
+        backend: Backend = "native",
+        flush_threshold: int = 256,
+        on_verdict: Callable[[Envelope, bool], None] | None = None,
+    ) -> None:
+        if backend not in _BACKENDS:
+            raise ValueError(f"unknown backend {backend!r}")
+        self.backend = backend
+        self.flush_threshold = flush_threshold
+        self.on_verdict = on_verdict
+        self._pending: List[Envelope] = []
+        self.stats = {"validated": 0, "accepted": 0, "rejected": 0}
+
+    def submit(self, env: Envelope) -> None:
+        self._pending.append(env)
+        if len(self._pending) >= self.flush_threshold:
+            self.flush()
+
+    def flush(self) -> List[Tuple[Envelope, bool]]:
+        if not self._pending:
+            return []
+        batch, self._pending = self._pending, []
+        # Structural screen BEFORE the backend call: a truncated/oversized key
+        # or signature (attacker-crafted wire bytes) gets a False verdict —
+        # it must not raise out of the batched backends and drop everyone
+        # else's verdicts with it.
+        well_formed = [
+            len(e.pubkey) == 32 and len(e.signature) == 64 for e in batch
+        ]
+        good = [e for e, w in zip(batch, well_formed) if w]
+        oks_good = iter(
+            _BACKENDS[self.backend](
+                [e.pubkey for e in good],
+                [signing_bytes(e.topic, e.seqno, e.payload) for e in good],
+                [e.signature for e in good],
+            )
+            if good
+            else []
+        )
+        oks = np.array(
+            [bool(next(oks_good)) if w else False for w in well_formed], bool
+        )
+        out = list(zip(batch, (bool(o) for o in oks)))
+        self.stats["validated"] += len(batch)
+        self.stats["accepted"] += int(np.sum(oks))
+        self.stats["rejected"] += len(batch) - int(np.sum(oks))
+        if self.on_verdict is not None:
+            for env, ok in out:
+                self.on_verdict(env, ok)
+        return out
+
+
+def verify_envelopes(
+    envs: Sequence[Envelope], backend: Backend = "native"
+) -> np.ndarray:
+    """One-shot batch verify of prepared envelopes -> bool[n]."""
+    pks = [e.pubkey for e in envs]
+    msgs = [signing_bytes(e.topic, e.seqno, e.payload) for e in envs]
+    sigs = [e.signature for e in envs]
+    return _BACKENDS[backend](pks, msgs, sigs)

@@ -178,7 +178,9 @@ class _TreeNode:
                     return
                 # Data/Join/Update from a child are protocol violations; the
                 # reference logs and ignores (subtree.go:71-73).
-        except (StreamClosed, asyncio.CancelledError):
+        except asyncio.CancelledError:
+            raise  # host teardown: do NOT run repair on a dying node
+        except StreamClosed:
             if not self.closed and not child.dead:
                 # Abrupt child death seen as read error: repair now instead of
                 # waiting for the next publish's write error.  Same observable
@@ -232,14 +234,9 @@ class _TreeNode:
         )
         dead = [tc for tc, r in zip(targets, results) if isinstance(r, Exception)]
         for cid, c in dead:
-            c.dead = True
-            c.stream.close()
-            if self.children.get(cid) is c:  # identity: see _drop_child
-                del self.children[cid]
-        for _, c in dead:
-            await self._redistribute(c.child_ids)
-        if dead:
-            await self.notify_parent_state()
+            # _drop_child's identity check also makes this a no-op when the
+            # child's own reader task already dropped (and redistributed) it.
+            await self._drop_child(cid, c)
 
     # -- join walk (client side) ---------------------------------------------
 
@@ -279,6 +276,23 @@ class _TreeNode:
                 continue
         s.close()
         raise StreamClosed(f"could not join any candidate parent: {last_err}")
+
+    async def drain_stale_adoptions(self) -> None:
+        """Close adoption streams that lost the race with another repair (or
+        with rejoin-at-root), sending Part so the would-be adopter drops its
+        child record cleanly.  No State ever flowed on these streams, so the
+        adopter's record has no grandchildren and its redistribute is a
+        no-op — nothing gets double-adopted."""
+        while True:
+            try:
+                s = self.pause.get_nowait()
+            except asyncio.QueueEmpty:
+                return
+            try:
+                await s.write_message(Message(type=MessageType.PART))
+            except StreamClosed:
+                pass
+            s.close()
 
     # -- teardown ------------------------------------------------------------
 
@@ -411,6 +425,10 @@ class LiveSubscription:
                 except asyncio.TimeoutError:
                     if not await self._rejoin_root():
                         return
+                # A second repairer (or an adoption racing the rejoin) may
+                # have queued another stream: keep the parent we have, Part
+                # the losers so no node retains us as an unread child.
+                await node.drain_stale_adoptions()
                 await node.notify_parent_state()
                 continue
             if m.type == MessageType.DATA:

@@ -1,0 +1,234 @@
+"""ed25519 validation stack: three implementations, one contract.
+
+Cross-checks the pure-Python oracle (crypto/ed25519_ref), the native C++
+batch verifier (native/ed25519 via crypto/native), and the JAX device kernel
+(ops/ed25519) against each other and against the OpenSSL-backed
+``cryptography`` package, including RFC 8032 edge cases (empty message,
+malleable S, corrupted points).
+"""
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu.crypto import ed25519_ref as ref
+from go_libp2p_pubsub_tpu.crypto import native
+from go_libp2p_pubsub_tpu.crypto.pipeline import (
+    Envelope,
+    ValidationPipeline,
+    sign_envelope,
+    verify_envelopes,
+)
+
+_HAVE_NATIVE = native.available()
+needs_native = pytest.mark.skipif(not _HAVE_NATIVE, reason="native build failed")
+
+
+def _rand_batch(n, msg_len=48, seed=1234):
+    rng = np.random.default_rng(seed)
+    seeds = [rng.bytes(32) for _ in range(n)]
+    msgs = [rng.bytes(msg_len + (i % 17)) for i in range(n)]
+    pks = [ref.public_key(s) for s in seeds]
+    sigs = [ref.sign(s, m) for s, m in zip(seeds, msgs)]
+    return seeds, msgs, pks, sigs
+
+
+# ---------------------------------------------------------------------------
+# oracle vs OpenSSL
+# ---------------------------------------------------------------------------
+
+
+def test_ref_matches_openssl():
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+
+    for i in range(8):
+        seed, msg = os.urandom(32), os.urandom(i * 9)
+        k = Ed25519PrivateKey.from_private_bytes(seed)
+        pk = k.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw
+        )
+        assert ref.public_key(seed) == pk
+        assert ref.sign(seed, msg) == k.sign(msg)
+        assert ref.verify(pk, msg, k.sign(msg))
+
+
+def test_ref_rejects_corruption_and_malleability():
+    seed, msg = b"\x01" * 32, b"hello"
+    pk, sig = ref.public_key(seed), ref.sign(seed, b"hello")
+    assert ref.verify(pk, msg, sig)
+    assert not ref.verify(pk, msg + b"x", sig)
+    bad = bytearray(sig)
+    bad[0] ^= 1
+    assert not ref.verify(pk, msg, bytes(bad))
+    s_plus_l = int.from_bytes(sig[32:], "little") + ref.L
+    assert not ref.verify(pk, msg, sig[:32] + s_plus_l.to_bytes(32, "little"))
+
+
+# ---------------------------------------------------------------------------
+# native C++
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+def test_native_sha512_matches_hashlib():
+    for msg in [b"", b"abc", b"q" * 111, b"w" * 112, b"e" * 127, b"r" * 128, b"t" * 9999]:
+        assert native.sha512(msg) == hashlib.sha512(msg).digest()
+
+
+@needs_native
+def test_native_matches_oracle():
+    seeds, msgs, pks, sigs = _rand_batch(16)
+    for s, m, pk, sig in zip(seeds, msgs, pks, sigs):
+        assert native.public_key(s) == pk
+        assert native.sign(s, m) == sig
+        assert native.verify(pk, m, sig)
+
+
+@needs_native
+def test_native_batch_verify_and_corruption():
+    _, msgs, pks, sigs = _rand_batch(64)
+    assert native.verify_batch(pks, msgs, sigs).all()
+    sigs = list(sigs)
+    for i in (0, 13, 40):
+        b = bytearray(sigs[i])
+        b[20] ^= 0x40
+        sigs[i] = bytes(b)
+    res = native.verify_batch(pks, msgs, sigs)
+    assert not res[[0, 13, 40]].any() and res.sum() == 61
+
+
+@needs_native
+def test_native_batch_sign_round_trip():
+    rng = np.random.default_rng(7)
+    seeds = [rng.bytes(32) for _ in range(32)]
+    msgs = [rng.bytes(10 + i) for i in range(32)]
+    pks = native.public_key_batch(seeds)
+    sigs = native.sign_batch(seeds, msgs)
+    for s, m, pk, sig in zip(seeds, msgs, pks, sigs):
+        assert sig == ref.sign(s, m)
+        assert pk == ref.public_key(s)
+
+
+@needs_native
+def test_native_rejects_malleable_s():
+    seed, msg = b"\x05" * 32, b"msg"
+    pk, sig = ref.public_key(seed), ref.sign(seed, msg)
+    s_plus_l = int.from_bytes(sig[32:], "little") + ref.L
+    mall = sig[:32] + s_plus_l.to_bytes(32, "little")
+    assert not native.verify_batch([pk], [msg], [mall])[0]
+
+
+# ---------------------------------------------------------------------------
+# device kernel
+# ---------------------------------------------------------------------------
+
+
+def test_device_field_ops_match_bigints():
+    import jax.numpy as jnp
+
+    from go_libp2p_pubsub_tpu.ops import ed25519 as dev
+
+    rng = np.random.default_rng(3)
+    vals_a = [int.from_bytes(rng.bytes(32), "little") % ref.P for _ in range(6)]
+    vals_b = [int.from_bytes(rng.bytes(32), "little") % ref.P for _ in range(6)]
+    al = jnp.asarray(np.stack([dev._int_to_limbs(v) for v in vals_a]))
+    bl = jnp.asarray(np.stack([dev._int_to_limbs(v) for v in vals_b]))
+    mul = np.asarray(dev.fe_canon(dev.fe_mul(al, bl)))
+    sub = np.asarray(dev.fe_canon(dev.fe_sub(al, bl)))
+    add = np.asarray(dev.fe_canon(dev.fe_add(al, bl)))
+    for i in range(6):
+        assert (mul[i] == dev._int_to_limbs(vals_a[i] * vals_b[i] % ref.P)).all()
+        assert (sub[i] == dev._int_to_limbs((vals_a[i] - vals_b[i]) % ref.P)).all()
+        assert (add[i] == dev._int_to_limbs((vals_a[i] + vals_b[i]) % ref.P)).all()
+
+
+def test_device_verify_matches_oracle():
+    from go_libp2p_pubsub_tpu.ops import ed25519 as dev
+
+    _, msgs, pks, sigs = _rand_batch(8)
+    assert dev.verify_batch(pks, msgs, sigs).all()
+    # corrupt signature / message / pubkey on three rows
+    sigs, msgs, pks = list(sigs), list(msgs), list(pks)
+    b = bytearray(sigs[0]); b[7] ^= 1; sigs[0] = bytes(b)
+    msgs[1] = msgs[1] + b"!"
+    b = bytearray(pks[2]); b[0] ^= 1; pks[2] = bytes(b)
+    res = dev.verify_batch(pks, msgs, sigs)
+    assert not res[:3].any() and res[3:].all()
+
+
+def test_device_rejects_malleable_and_noncanonical():
+    from go_libp2p_pubsub_tpu.ops import ed25519 as dev
+
+    seed, msg = b"\x09" * 32, b"payload"
+    pk, sig = ref.public_key(seed), ref.sign(seed, msg)
+    s_plus_l = int.from_bytes(sig[32:], "little") + ref.L
+    mall = sig[:32] + s_plus_l.to_bytes(32, "little")
+    # non-canonical R encoding: y >= p
+    bad_r = (ref.P + 1).to_bytes(32, "little")
+    res = dev.verify_batch(
+        [pk, pk, pk], [msg, msg, msg], [mall, bad_r + sig[32:], sig]
+    )
+    assert not res[0] and not res[1] and res[2]
+
+
+# ---------------------------------------------------------------------------
+# pipeline
+# ---------------------------------------------------------------------------
+
+
+def _pipeline_backend():
+    return "native" if _HAVE_NATIVE else "python"
+
+
+def test_envelope_round_trip():
+    env = sign_envelope(b"\x03" * 32, "topic-x", 42, b"\x00\xffdata")
+    back = Envelope.from_wire(env.to_wire())
+    assert back == env
+
+
+def test_pipeline_verdicts_and_stats():
+    seeds = [os.urandom(32) for _ in range(6)]
+    envs = [
+        sign_envelope(s, "t", i, f"payload {i}".encode())
+        for i, s in enumerate(seeds)
+    ]
+    # tamper: replay env 0's signature on env 1's payload
+    envs[1] = Envelope(
+        envs[1].topic, envs[1].seqno, envs[1].payload, envs[0].pubkey,
+        envs[0].signature,
+    )
+    verdicts = {}
+    pipe = ValidationPipeline(
+        backend=_pipeline_backend(),
+        flush_threshold=4,
+        on_verdict=lambda e, ok: verdicts.__setitem__(e.seqno, ok),
+    )
+    for e in envs:
+        pipe.submit(e)
+    pipe.flush()
+    assert verdicts == {0: True, 1: False, 2: True, 3: True, 4: True, 5: True}
+    assert pipe.stats == {"validated": 6, "accepted": 5, "rejected": 1}
+
+
+def test_cross_topic_replay_rejected():
+    env = sign_envelope(b"\x04" * 32, "alpha", 7, b"x")
+    forged = Envelope("beta", env.seqno, env.payload, env.pubkey, env.signature)
+    res = verify_envelopes([env, forged], backend=_pipeline_backend())
+    assert res[0] and not res[1]
+
+
+def test_pipeline_survives_malformed_envelope():
+    """A truncated pubkey/signature must yield a False verdict, not crash the
+    batch (regression: backends raised and the whole batch lost verdicts)."""
+    good = [sign_envelope(os.urandom(32), "t", i, b"ok") for i in range(3)]
+    bad = Envelope("t", 99, b"x", b"\x01" * 7, b"\x02" * 64)  # short pubkey
+    bad2 = Envelope("t", 98, b"x", good[0].pubkey, b"\x02" * 10)  # short sig
+    pipe = ValidationPipeline(backend=_pipeline_backend(), flush_threshold=100)
+    for e in [good[0], bad, good[1], bad2, good[2]]:
+        pipe.submit(e)
+    out = dict((e.seqno, ok) for e, ok in pipe.flush())
+    assert out == {0: True, 99: False, 1: True, 98: False, 2: True}
+    assert pipe.stats["rejected"] == 2 and pipe.stats["accepted"] == 3

@@ -141,22 +141,37 @@ def test_live_multi_topic(net):
 
 def test_live_repair_timeout_rejoins_at_root():
     """Orphan whose repairer never dials rejoins at the root after the
-    deadline — the reference's panic path (client.go:96-98), fixed."""
+    deadline — the reference's panic path (client.go:96-98), fixed.
+
+    Deterministic: the root's redistribution is disabled (a repairer that
+    never dials), so the orphan can ONLY recover via the watchdog's
+    rejoin-at-root — if _rejoin_root regresses, this test fails."""
+    from go_libp2p_pubsub_tpu.config import TreeOpts
+
     net = LiveNetwork(repair_timeout_s=0.3)
     try:
-        hosts, topic, subchs = init_pubsub(net, 4)
-        check_system(topic, subchs, None, 0)
-        # Kill hosts[1]; repair by the root re-adopts its children quickly,
-        # but if the root itself were slow the watchdog path fires.  Exercise
-        # the watchdog deterministically: kill and immediately also kill the
-        # repairer's view by closing nothing else — the orphan either gets
-        # adopted (fast path) or rejoins root (timeout path); both must
-        # converge to full delivery.
-        hosts[1].close()
-        time.sleep(0.6)  # > repair_timeout_s: watchdog has fired if needed
-        settle_and_clear(subchs)
-        for i in range(5):
-            check_system(topic, subchs, {0}, i + 100)
+        hosts = net.make_hosts(3)
+        # Width-1 chain: root -> A -> B.
+        topic = hosts[0].new_topic("chain", TreeOpts(tree_width=1, tree_max_width=1))
+        sub_a = hosts[1].subscribe(hosts[0].id, "chain")
+        sub_b = hosts[2].subscribe(hosts[0].id, "chain")
+        topic.publish_message(b"pre")
+        assert sub_a.get(timeout=5.0) == b"pre" and sub_b.get(timeout=5.0) == b"pre"
+
+        async def cripple_repairer():
+            async def no_redistribute(_gids):
+                return None
+
+            topic.topic.node._redistribute = no_redistribute
+
+        net.call(cripple_repairer())
+        hosts[1].close()  # B is orphaned; nobody will dial it
+        time.sleep(0.8)   # > repair_timeout_s: watchdog must have rejoined B
+        sub_b.clear()
+        topic.publish_message(b"post")
+        assert sub_b.get(timeout=5.0) == b"post"
+        # B's parent is now the root itself — proof the rejoin path ran.
+        assert sub_b.sub.node.parent_stream.remote_peer == hosts[0].id
     finally:
         net.shutdown()
 
