@@ -123,6 +123,8 @@ def sha512(msg: bytes) -> bytes:
 
 
 def public_key(seed: bytes) -> bytes:
+    if len(seed) != 32:
+        raise ValueError(f"seed must be 32 bytes, got {len(seed)}")
     lib = _load()
     s = np.frombuffer(seed, dtype=np.uint8).copy()
     out = np.zeros(32, np.uint8)
@@ -131,6 +133,8 @@ def public_key(seed: bytes) -> bytes:
 
 
 def sign(seed: bytes, msg: bytes) -> bytes:
+    if len(seed) != 32:
+        raise ValueError(f"seed must be 32 bytes, got {len(seed)}")
     lib = _load()
     s = np.frombuffer(seed, dtype=np.uint8).copy()
     m = np.frombuffer(msg, dtype=np.uint8) if msg else np.zeros(1, np.uint8)
@@ -140,6 +144,11 @@ def sign(seed: bytes, msg: bytes) -> bytes:
 
 
 def verify(pk: bytes, msg: bytes, sig: bytes) -> bool:
+    # Malformed authenticators are a defined reject, matching ed25519_ref —
+    # the C side reads exactly 32/64 bytes and must never read past a short
+    # buffer.
+    if len(pk) != 32 or len(sig) != 64:
+        return False
     lib = _load()
     p = np.frombuffer(pk, dtype=np.uint8).copy()
     g = np.frombuffer(sig, dtype=np.uint8).copy()

@@ -46,11 +46,9 @@ class Envelope:
     signature: bytes  # 64B
 
     def to_wire(self) -> bytes:
-        t = self.topic.encode()
+        # Header layout == signature domain (one definition, can't drift).
         return (
-            struct.pack("<I", len(t))
-            + t
-            + struct.pack("<Q", self.seqno)
+            signing_bytes(self.topic, self.seqno, b"")
             + self.pubkey
             + self.signature
             + self.payload
@@ -145,15 +143,23 @@ class ValidationPipeline:
             len(e.pubkey) == 32 and len(e.signature) == 64 for e in batch
         ]
         good = [e for e, w in zip(batch, well_formed) if w]
-        oks_good = iter(
-            _BACKENDS[self.backend](
-                [e.pubkey for e in good],
-                [signing_bytes(e.topic, e.seqno, e.payload) for e in good],
-                [e.signature for e in good],
+        try:
+            verdicts = (
+                _BACKENDS[self.backend](
+                    [e.pubkey for e in good],
+                    [signing_bytes(e.topic, e.seqno, e.payload) for e in good],
+                    [e.signature for e in good],
+                )
+                if good
+                else []
             )
-            if good
-            else []
-        )
+        except Exception:
+            # Backend infrastructure failure (e.g. native build unavailable):
+            # re-queue the batch so no envelope silently loses its verdict,
+            # then propagate so the caller can pick another backend.
+            self._pending = batch + self._pending
+            raise
+        oks_good = iter(verdicts)
         oks = np.array(
             [bool(next(oks_good)) if w else False for w in well_formed], bool
         )

@@ -233,6 +233,12 @@ class _TreeNode:
             *(send(c) for _, c in targets), return_exceptions=True
         )
         dead = [tc for tc, r in zip(targets, results) if isinstance(r, Exception)]
+        # Mark ALL failed children dead before redistributing any of them:
+        # otherwise the first redistribution's redirect walk can pick a
+        # sibling that also just failed this gather but is not yet marked,
+        # stranding the grandchild on a dead parent until repair timeout.
+        for _, c in dead:
+            c.dead = True
         for cid, c in dead:
             # _drop_child's identity check also makes this a no-op when the
             # child's own reader task already dropped (and redistributed) it.
@@ -424,6 +430,10 @@ class LiveSubscription:
                     )
                 except asyncio.TimeoutError:
                     if not await self._rejoin_root():
+                        # Unreachable root: this subscription is over, but an
+                        # adoption may still race in — Part any queued streams
+                        # so no repairer retains us as an unread child.
+                        await node.drain_stale_adoptions()
                         return
                 # A second repairer (or an adoption racing the rejoin) may
                 # have queued another stream: keep the parent we have, Part

@@ -1,1 +1,11 @@
-"""Aux subsystems: logging, metrics, checkpointing, fault injection, tracing."""
+"""Aux subsystems (SURVEY.md §5): checkpointing, metrics, fault injection,
+tracing/profiling, structured logging.
+
+All device-facing pieces are pure functions over the engine states; nothing
+here touches the hot loops.
+"""
+
+from . import checkpoint, faults, metrics, trace
+from .log import get_logger, kv
+
+__all__ = ["checkpoint", "faults", "metrics", "trace", "get_logger", "kv"]

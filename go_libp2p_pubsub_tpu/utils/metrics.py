@@ -1,0 +1,124 @@
+"""Metrics & observability: counters as reduced device arrays.
+
+The reference's only observability is a ``go-log`` logger with ~20 call
+sites and zero counters (SURVEY.md §5.5).  The TPU-native design inverts
+this: the interesting quantities (deliveries, repairs, mesh health, score
+distribution, validation throughput) already *are* device arrays inside the
+state, so metrics are pure jitted reductions over state — no instrumentation
+in the hot loop, no host sync until the host asks for a snapshot.
+
+Two pieces:
+- pure reduction functions ``tree_metrics`` / ``gossip_metrics`` over the
+  engine states (device-side, jittable, safe to call every step);
+- a tiny host-side ``MetricsRegistry`` aggregating named scalar series for
+  export (the Prometheus-shaped surface the Go ecosystem would expect).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------------------
+# device-side reductions
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def tree_metrics(st) -> Dict[str, jax.Array]:
+    """Reductions over a ``TreeState`` (the v0-parity engine).
+
+    Mirrors what the reference could only learn by grepping logs: delivery
+    totals (``client.go:124-127``), membership (``subtree.go:152``), orphan
+    backlog (the repair window of SURVEY.md §3.6/§3.7).
+    """
+    alive = st.alive
+    joined = st.joined & alive
+    orphaned = alive & ~st.joined & (st.join_target >= 0)
+    return {
+        "peers_alive": alive.sum(),
+        "peers_joined": joined.sum(),
+        "peers_orphaned": orphaned.sum(),
+        "msgs_delivered_total": st.out_len.sum(),
+        "msgs_undrained": (st.out_len - st.out_drained).sum(),
+        "queue_backlog": st.q_len.sum(),
+        "max_queue_depth": st.q_len.max(),
+        "tree_depth_proxy": st.subtree_size.max(),
+        "step": st.step_num,
+    }
+
+
+@jax.jit
+def gossip_metrics(st) -> Dict[str, jax.Array]:
+    """Reductions over a ``GossipState``: mesh health + delivery + scoring."""
+    alive = st.alive
+    alive_n = jnp.maximum(alive.sum(), 1)
+    mesh_deg = (st.mesh & st.nbr_valid).sum(axis=1)
+    in_window = st.msg_used & st.msg_valid
+    delivered = (st.have & alive[:, None]).sum(axis=0)
+    frac = jnp.where(in_window, delivered / alive_n, jnp.nan)
+    scores_live = jnp.where(st.nbr_valid, st.scores, jnp.nan)
+    return {
+        "peers_alive": alive.sum(),
+        "mesh_degree_mean": jnp.where(alive, mesh_deg, 0).sum() / alive_n,
+        "mesh_degree_max": mesh_deg.max(),
+        "msgs_in_window": in_window.sum(),
+        "delivery_frac_mean": jnp.nanmean(frac),
+        "deliveries_total": (st.have & alive[:, None] & in_window[None, :]).sum(),
+        "score_mean": jnp.nanmean(scores_live),
+        "score_min": jnp.nanmin(scores_live),
+        "gossip_pending": st.gossip_pend.sum(),
+        "step": st.step,
+    }
+
+
+def snapshot(metrics: Dict[str, jax.Array]) -> Dict[str, float]:
+    """One host sync for a whole metrics dict (device_get once, not per key)."""
+    host = jax.device_get(metrics)
+    return {k: float(v) for k, v in host.items()}
+
+
+# ---------------------------------------------------------------------------
+# host-side registry
+# ---------------------------------------------------------------------------
+
+class MetricsRegistry:
+    """Named scalar time series with counter/gauge semantics.
+
+    The host plane (``net/live.py``) and benchmark harnesses record here;
+    ``export()`` emits JSON lines, the build's analog of a metrics endpoint.
+    """
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self._counters: Dict[str, float] = {}
+        self._series: Dict[str, List[Tuple[float, float]]] = {}
+
+    def inc(self, name: str, value: float = 1.0) -> None:
+        self._counters[name] = self._counters.get(name, 0.0) + value
+
+    def gauge(self, name: str, value: float) -> None:
+        self._series.setdefault(name, []).append((self._clock(), float(value)))
+
+    def observe_state(self, prefix: str, metrics: Dict[str, jax.Array]) -> None:
+        """Record a device metrics dict as gauges under ``prefix.*``."""
+        for k, v in snapshot(metrics).items():
+            self.gauge(f"{prefix}.{k}", v)
+
+    def counters(self) -> Dict[str, float]:
+        return dict(self._counters)
+
+    def latest(self, name: str) -> Optional[float]:
+        s = self._series.get(name)
+        return s[-1][1] if s else None
+
+    def export(self) -> str:
+        """All counters + latest gauges as one JSON object string."""
+        out: Dict[str, Any] = {f"counter.{k}": v for k, v in self._counters.items()}
+        for name, series in self._series.items():
+            out[f"gauge.{name}"] = series[-1][1]
+        return json.dumps(out, sort_keys=True)

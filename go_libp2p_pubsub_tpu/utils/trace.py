@@ -1,0 +1,133 @@
+"""Tracing / profiling: step timers, XLA profiler capture, topology dumps.
+
+The reference's entire tracing story is an unused debug tree-printer reaching
+into private state (``printTree``, ``pubsub_test.go:204-229``) (SURVEY.md
+§5.1).  Here the equivalents are first-class: wall-clock phase timers around
+jitted calls (with ``block_until_ready`` so device work is actually measured),
+an optional ``jax.profiler`` trace capture for XLA-level analysis, and
+topology snapshot exporters that turn the device-resident overlay back into
+host structures for inspection.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+import jax
+import numpy as np
+
+
+class StepTimer:
+    """Accumulating named phase timer.
+
+    ``with timer("propagate"): st = gs.step(st)`` — each phase records a
+    wall-time sample; device work is fenced with ``block_until_ready`` on the
+    value passed to ``fence`` (or skipped if none is set before exit).
+    """
+
+    def __init__(self):
+        self.samples: Dict[str, List[float]] = {}
+        self._fence_val: Any = None
+
+    def fence(self, value: Any) -> Any:
+        """Mark ``value`` to be block_until_ready'd when the phase closes."""
+        self._fence_val = value
+        return value
+
+    @contextlib.contextmanager
+    def __call__(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if self._fence_val is not None:
+                jax.block_until_ready(self._fence_val)
+                self._fence_val = None
+            self.samples.setdefault(name, []).append(time.perf_counter() - t0)
+
+    def stats(self) -> Dict[str, Dict[str, float]]:
+        out = {}
+        for name, xs in self.samples.items():
+            a = np.asarray(xs)
+            out[name] = {
+                "count": int(a.size),
+                "total_s": float(a.sum()),
+                "mean_ms": float(a.mean() * 1e3),
+                "p50_ms": float(np.percentile(a, 50) * 1e3),
+                "max_ms": float(a.max() * 1e3),
+            }
+        return out
+
+
+@contextlib.contextmanager
+def xla_trace(log_dir: Optional[str]) -> Iterator[None]:
+    """Capture an XLA profiler trace into ``log_dir`` (TensorBoard-viewable).
+
+    No-op when ``log_dir`` is None, so callers can wire it to a config flag
+    unconditionally.
+    """
+    if log_dir is None:
+        yield
+        return
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+# ---------------------------------------------------------------------------
+# topology snapshot export (the printTree analog)
+# ---------------------------------------------------------------------------
+
+def export_tree(st) -> Dict[int, Any]:
+    """TreeState -> nested {peer: {child: {...}}} dict rooted at ``st.root``.
+
+    Host-side, for debugging and golden-topology assertions; the recursive
+    shape mirrors what ``printTree`` printed from private Go state.
+    """
+    parent = np.asarray(jax.device_get(st.parent))
+    joined = np.asarray(jax.device_get(st.joined))
+    root = int(jax.device_get(st.root))
+    kids: Dict[int, List[int]] = {}
+    for p in range(parent.shape[0]):
+        if joined[p] and parent[p] >= 0:
+            kids.setdefault(int(parent[p]), []).append(p)
+
+    # Iterative DFS: a width-1 chain is a legal topology, so depth can reach
+    # N — far past Python's recursion limit at sim scale.
+    out: Dict[int, Any] = {root: {}}
+    stack: List[tuple] = [(root, out[root])]
+    visited = {root}
+    while stack:
+        node, slot = stack.pop()
+        for c in kids.get(node, []):
+            if c in visited:  # cycle — never legal in a tree
+                raise ValueError(f"cycle detected at peer {c}")
+            visited.add(c)
+            slot[c] = {}
+            stack.append((c, slot[c]))
+    return out
+
+
+def tree_text(st) -> str:
+    """Indented text rendering of ``export_tree`` (one peer per line)."""
+    lines: List[str] = []
+    stack: List[tuple] = [(node, 0, d) for node, d in
+                          sorted(export_tree(st).items(), reverse=True)]
+    while stack:
+        node, depth, d = stack.pop()
+        lines.append("  " * depth + str(node))
+        stack.extend((c, depth + 1, d[c]) for c in sorted(d, reverse=True))
+    return "\n".join(lines)
+
+
+def export_mesh(st) -> Dict[int, List[int]]:
+    """GossipState -> {peer: sorted mesh-neighbor ids} adjacency dict."""
+    mesh = np.asarray(jax.device_get(st.mesh & st.nbr_valid))
+    nbrs = np.asarray(jax.device_get(st.nbrs))
+    alive = np.asarray(jax.device_get(st.alive))
+    out: Dict[int, List[int]] = {}
+    for p in range(mesh.shape[0]):
+        if alive[p]:
+            out[p] = sorted(int(nbrs[p, s]) for s in np.nonzero(mesh[p])[0])
+    return out

@@ -1,0 +1,242 @@
+"""Aux-subsystem tests: checkpoint round-trips, metrics reductions, fault
+plans, topology export (SURVEY.md §5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu.config import SimParams, TreeOpts
+from go_libp2p_pubsub_tpu.models.gossipsub import GossipSub
+from go_libp2p_pubsub_tpu.ops import tree as tree_ops
+from go_libp2p_pubsub_tpu.utils import checkpoint, faults, metrics, trace
+
+
+def small_tree(n=8):
+    params = SimParams(max_peers=n, max_width=8, queue_cap=16, out_cap=32)
+    st = tree_ops.init_state(params, TreeOpts(), root=0)
+    st = tree_ops.begin_subscribe_many(st, jnp.arange(n) > 0)
+    st = tree_ops.run_steps(st, 4 * int(np.ceil(np.log2(n))) + 8)
+    return st
+
+
+# ---------------------------------------------------------------------------
+# checkpoint
+# ---------------------------------------------------------------------------
+
+class TestCheckpoint:
+    def test_tree_state_roundtrip(self, tmp_path):
+        st = small_tree()
+        st = tree_ops.publish_many(st, jnp.arange(3, dtype=jnp.int32))
+        p = str(tmp_path / "tree.ckpt")
+        checkpoint.save(p, st, meta={"step": 7})
+
+        template = tree_ops.init_state(
+            SimParams(max_peers=8, max_width=8, queue_cap=16, out_cap=32),
+            TreeOpts(),
+        )
+        back = checkpoint.restore(p, template)
+        for a, b in zip(jax.tree_util.tree_leaves(st), jax.tree_util.tree_leaves(back)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert checkpoint.meta(p) == {"step": 7}
+
+    def test_resumed_sim_continues_identically(self, tmp_path):
+        """Restore + run == run straight through: checkpointing is invisible
+        to the dynamics (the §5.4 contract)."""
+        st = small_tree()
+        st = tree_ops.publish_many(st, jnp.arange(4, dtype=jnp.int32))
+        p = str(tmp_path / "mid.ckpt")
+        checkpoint.save(p, st)
+        straight = tree_ops.run_steps(st, 12)
+        resumed = tree_ops.run_steps(
+            checkpoint.restore(p, jax.tree_util.tree_map(jnp.zeros_like, st)), 12
+        )
+        np.testing.assert_array_equal(
+            np.asarray(straight.out_len), np.asarray(resumed.out_len)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(straight.out), np.asarray(resumed.out)
+        )
+
+    def test_gossip_state_roundtrip(self, tmp_path):
+        gs = GossipSub(n_peers=32, n_slots=8, conn_degree=4, msg_window=8)
+        st = gs.init(seed=1)
+        p = str(tmp_path / "gossip.ckpt")
+        checkpoint.save(p, st)
+        back = checkpoint.restore(p, gs.init(seed=0))
+        for a, b in zip(jax.tree_util.tree_leaves(st), jax.tree_util.tree_leaves(back)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_shape_mismatch_rejected(self, tmp_path):
+        st = small_tree(8)
+        p = str(tmp_path / "t.ckpt")
+        checkpoint.save(p, st)
+        wrong = tree_ops.init_state(
+            SimParams(max_peers=16, max_width=8, queue_cap=16, out_cap=32),
+            TreeOpts(),
+        )
+        with pytest.raises(ValueError, match="leaf"):
+            checkpoint.restore(p, wrong)
+
+    def test_structure_mismatch_rejected(self, tmp_path):
+        st = small_tree(8)
+        p = str(tmp_path / "t.ckpt")
+        checkpoint.save(p, st)
+        with pytest.raises(ValueError, match="mismatch"):
+            checkpoint.restore(p, {"only": jnp.zeros(3)})
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+class TestMetrics:
+    def test_tree_metrics_counts(self):
+        st = small_tree(8)
+        m = metrics.snapshot(metrics.tree_metrics(st))
+        assert m["peers_alive"] == 8
+        assert m["peers_joined"] == 8
+        assert m["peers_orphaned"] == 0
+        assert m["msgs_delivered_total"] == 0
+
+        st = tree_ops.publish_many(st, jnp.arange(2, dtype=jnp.int32))
+        st = tree_ops.run_steps(st, 16)
+        m2 = metrics.snapshot(metrics.tree_metrics(st))
+        assert m2["msgs_delivered_total"] == 2 * 7  # every subscriber, 2 msgs
+
+    def test_gossip_metrics_delivery(self):
+        gs = GossipSub(n_peers=64, n_slots=16, conn_degree=8, msg_window=8)
+        st = gs.init(seed=0)
+        st = gs.publish(st, jnp.asarray(0), jnp.asarray(0), jnp.asarray(True))
+        st = gs.run(st, 24)
+        m = metrics.snapshot(metrics.gossip_metrics(st))
+        assert m["peers_alive"] == 64
+        assert m["msgs_in_window"] == 1
+        assert m["delivery_frac_mean"] == pytest.approx(1.0)
+        assert m["mesh_degree_mean"] > 0
+
+    def test_registry_export(self):
+        reg = metrics.MetricsRegistry(clock=lambda: 0.0)
+        reg.inc("msgs_validated", 5)
+        reg.inc("msgs_validated", 3)
+        reg.gauge("depth", 4.0)
+        reg.gauge("depth", 5.0)
+        assert reg.counters() == {"msgs_validated": 8.0}
+        assert reg.latest("depth") == 5.0
+        assert '"counter.msgs_validated": 8.0' in reg.export()
+
+    def test_observe_state(self):
+        reg = metrics.MetricsRegistry()
+        reg.observe_state("tree", metrics.tree_metrics(small_tree(8)))
+        assert reg.latest("tree.peers_alive") == 8.0
+
+
+# ---------------------------------------------------------------------------
+# fault plans
+# ---------------------------------------------------------------------------
+
+class TestFaults:
+    def test_liveness_timeline(self):
+        plan = faults.FaultPlan().kill_at(3, [1, 2], 8).kill_at(6, [5], 8)
+        tl = plan.liveness_timeline(8, 8)
+        assert tl[2].all()
+        assert not tl[3, 1] and not tl[3, 2] and tl[3, 5]
+        assert not tl[7, 5]
+
+    def test_run_with_faults_tree_kill(self):
+        st = small_tree(8)
+        st = tree_ops.publish_many(st, jnp.arange(6, dtype=jnp.int32))
+        plan = faults.FaultPlan().kill_at(4, [3], 8)
+        out = faults.run_with_faults(
+            st,
+            40,
+            lambda s, k: tree_ops.run_steps(s, k),
+            plan,
+            lambda s, m: s._replace(alive=s.alive & ~m),
+        )
+        alive = np.asarray(out.alive)
+        assert not alive[3]
+        # Survivors keep receiving: repair re-homed any orphaned subtree.
+        out_len = np.asarray(out.out_len)
+        live_subs = [p for p in range(1, 8) if p != 3]
+        assert all(out_len[p] > 0 for p in live_subs)
+
+    def test_run_with_faults_gossip(self):
+        gs = GossipSub(n_peers=64, n_slots=16, conn_degree=8, msg_window=8)
+        st = gs.init(seed=0)
+        st = gs.publish(st, jnp.asarray(0), jnp.asarray(0), jnp.asarray(True))
+        kill = np.zeros(64, bool)
+        kill[10:20] = True
+        plan = faults.FaultPlan()
+        plan.kills[2] = kill
+        out = faults.run_with_faults(st, 32, gs.run, plan, gs.kill_peers)
+        assert int(np.asarray(out.alive).sum()) == 54
+        have = np.asarray(out.have[:, 0])
+        alive = np.asarray(out.alive)
+        assert have[alive].all(), "all survivors must still get the message"
+
+    def test_leaves_require_leave_fn(self):
+        st = small_tree(4)
+        plan = faults.FaultPlan().leave_at(1, [2], 4)
+        with pytest.raises(ValueError, match="leave_fn"):
+            faults.run_with_faults(
+                st, 4, lambda s, k: tree_ops.run_steps(s, k), plan,
+                lambda s, m: s,
+            )
+
+    def test_sybil_groups(self):
+        g = faults.sybil_ip_groups(16, 4)
+        assert (g[:4] == 0).all()
+        assert len(set(g[4:].tolist())) == 12
+
+    def test_eclipse_campaign_shapes(self):
+        rng = np.random.default_rng(0)
+        attackers, plan = faults.eclipse_campaign(
+            rng, n=32, target=0, n_attackers=8, start_step=4, n_steps=32
+        )
+        assert attackers.sum() == 8
+        assert plan.event_steps()
+        for t, m in plan.kills.items():
+            assert not m[0], "never kill the target itself"
+            assert not (m & attackers).any(), "attackers don't kill themselves"
+
+
+# ---------------------------------------------------------------------------
+# trace / topology export
+# ---------------------------------------------------------------------------
+
+class TestTrace:
+    def test_export_tree_contains_all_joined(self):
+        st = small_tree(8)
+        topo = trace.export_tree(st)
+        seen = []
+
+        def walk(d):
+            for k, v in d.items():
+                seen.append(k)
+                walk(v)
+
+        walk(topo)
+        assert sorted(seen) == list(range(8))
+        assert list(topo.keys()) == [0]  # rooted at the topic root
+
+    def test_tree_text(self):
+        txt = trace.tree_text(small_tree(4))
+        assert txt.splitlines()[0] == "0"
+        assert len(txt.splitlines()) == 4
+
+    def test_export_mesh_symmetric(self):
+        gs = GossipSub(n_peers=32, n_slots=8, conn_degree=4, msg_window=4)
+        st = gs.init(seed=0)
+        adj = trace.export_mesh(st)
+        for p, nbrs in adj.items():
+            for q in nbrs:
+                assert p in adj[q], f"mesh edge {p}->{q} not symmetric"
+
+    def test_step_timer(self):
+        t = trace.StepTimer()
+        with t("phase"):
+            t.fence(jnp.zeros(4) + 1)
+        s = t.stats()
+        assert s["phase"]["count"] == 1
+        assert s["phase"]["total_s"] >= 0
