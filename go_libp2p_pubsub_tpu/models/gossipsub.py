@@ -10,6 +10,12 @@ sim (see ``parallel/``).
 The v0 reference contains none of this (SURVEY.md §0) — it is the capability
 envelope the framework grows into; the protocol rules follow the public
 GossipSub spec, with the simplifications documented in ``ops/gossip.py``.
+
+Message windows are **bit-packed** (``ops/bitpack.py``): possession, fresh,
+and gossip-pending state are uint32 words, so the propagate hot loop moves
+32x less HBM traffic than the bool-tensor form — the difference between 1k
+and 100k peers fitting on one chip.  ``ops/gossip.py`` keeps the unpacked
+reference kernels the packed path is equivalence-tested against.
 """
 
 from __future__ import annotations
@@ -22,36 +28,39 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import GossipSubParams, ScoreParams
-from ..ops import gossip as gossip_ops
+from ..ops import bitpack
+from ..ops import gossip_packed as gossip_ops
 from ..ops import scoring as scoring_ops
+from ..ops.gossip import heartbeat_mesh
 from ..ops.scoring import GlobalCounters, TopicCounters
 
 
 class GossipState(NamedTuple):
-    """Single-topic mesh state.  N peers, K neighbor slots, M message window.
+    """Single-topic mesh state.  N peers, K neighbor slots, M message window
+    (stored packed: W = ceil(M/32) uint32 words per peer).
 
     Multi-topic operation stacks these via ``jax.vmap`` (topology shared,
     mesh/counters per topic); global score counters live outside the vmap.
     """
 
-    nbrs: jax.Array        # i32[N, K] connection slots -> remote peer id
-    rev: jax.Array         # i32[N, K] remote's slot index back to me
-    nbr_valid: jax.Array   # bool[N, K]
-    alive: jax.Array       # bool[N]
-    mesh: jax.Array        # bool[N, K] symmetric mesh membership
-    counters: TopicCounters    # per-slot topic score counters
-    gcounters: GlobalCounters  # per-peer global score inputs
-    scores: jax.Array      # f32[N, K] cached neighbor scores (last heartbeat)
-    have: jax.Array        # bool[N, M] possession (seen-cache within window)
-    fresh: jax.Array       # bool[N, M] first-received last round
-    gossip_pend: jax.Array # bool[N, M] IWANT deliveries due next round
-    first_step: jax.Array  # i32[N, M] first-receipt step, -1 = never
-    msg_valid: jax.Array   # bool[M] validation verdict
-    msg_birth: jax.Array   # i32[M] publish step
-    msg_active: jax.Array  # bool[M] within the mcache/gossip window
-    msg_used: jax.Array    # bool[M] ever published (persists until slot reuse)
-    key: jax.Array         # PRNG key
-    step: jax.Array        # i32
+    nbrs: jax.Array         # i32[N, K] connection slots -> remote peer id
+    rev: jax.Array          # i32[N, K] remote's slot index back to me
+    nbr_valid: jax.Array    # bool[N, K]
+    alive: jax.Array        # bool[N]
+    mesh: jax.Array         # bool[N, K] symmetric mesh membership
+    counters: TopicCounters     # per-slot topic score counters
+    gcounters: GlobalCounters   # per-peer global score inputs
+    scores: jax.Array       # f32[N, K] cached neighbor scores (last heartbeat)
+    have_w: jax.Array       # u32[N, W] possession (seen-cache within window)
+    fresh_w: jax.Array      # u32[N, W] first-received last round
+    gossip_pend_w: jax.Array  # u32[N, W] IWANT deliveries due next round
+    first_step: jax.Array   # i32[N, M] first-receipt step, -1 = never
+    msg_valid: jax.Array    # bool[M] validation verdict
+    msg_birth: jax.Array    # i32[M] publish step
+    msg_active: jax.Array   # bool[M] within the mcache/gossip window
+    msg_used: jax.Array     # bool[M] ever published (persists until slot reuse)
+    key: jax.Array          # PRNG key
+    step: jax.Array         # i32
 
 
 def build_topology(
@@ -86,6 +95,60 @@ def build_topology(
     return nbrs, rev, nbrs >= 0
 
 
+def build_topology_fast(
+    rng: np.random.Generator, n: int, k: int, degree: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Vectorized topology builder for large N (100k peers in ~100 ms where
+    the per-edge Python loop of ``build_topology`` takes minutes).
+
+    Same construction idea — union of ``degree`` random pairings — but each
+    pairing is admitted with NumPy set-ops instead of per-edge Python.
+    Duplicate edges across rounds are dropped (slightly lower mean degree,
+    same as the loop version's skip rule).
+    """
+    if degree >= k:
+        raise ValueError(f"degree ({degree}) must be < slot count k ({k})")
+    if degree == 0:
+        empty = np.full((n, k), -1, np.int64)
+        return empty, empty.copy(), empty >= 0
+    pairs = []
+    for _ in range(degree):
+        perm = rng.permutation(n).astype(np.int64)
+        a, b = perm[0 : n - 1 : 2], perm[1:n:2]
+        pairs.append(np.stack([np.minimum(a, b), np.maximum(a, b)], 1))
+    e = np.unique(np.concatenate(pairs, 0), axis=0)  # dedup undirected edges
+    # Per-endpoint slot indices via cumulative counts; drop edges overflowing k.
+    src = np.concatenate([e[:, 0], e[:, 1]])
+    dst = np.concatenate([e[:, 1], e[:, 0]])
+    order = np.argsort(src, kind="stable")
+    src_s, dst_s = src[order], dst[order]
+    counts = np.bincount(src_s, minlength=n)
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    slot_s = np.arange(len(src_s)) - starts[src_s]
+    ok_s = slot_s < k
+    # An edge survives only if BOTH directions got a slot.
+    eid = np.concatenate([np.arange(len(e)), np.arange(len(e))])[order]
+    ok_edge = np.ones(len(e), bool)
+    np.logical_and.at(ok_edge, eid, ok_s)
+    keep = ok_edge[eid]
+    src_s, dst_s, slot_s, eid = src_s[keep], dst_s[keep], slot_s[keep], eid[keep]
+    # Recompute dense slots after the drop.
+    counts = np.bincount(src_s, minlength=n)
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    slot_s = np.arange(len(src_s)) - starts[src_s]
+    nbrs = np.full((n, k), -1, np.int64)
+    rev = np.full((n, k), -1, np.int64)
+    nbrs[src_s, slot_s] = dst_s
+    # rev: my slot back-pointer = the slot my counterpart assigned this edge.
+    # Sort by (eid, src): the two directions of each edge become adjacent
+    # pairs, and each direction's rev is its pair partner's slot.
+    o2 = np.lexsort((src_s, eid))
+    rev_sorted = np.empty(len(src_s), np.int64)
+    rev_sorted[o2] = slot_s[o2].reshape(-1, 2)[:, ::-1].reshape(-1)
+    rev[src_s, slot_s] = rev_sorted
+    return nbrs, rev, nbrs >= 0
+
+
 class GossipSub:
     """Single-topic GossipSub simulator with static shapes."""
 
@@ -102,6 +165,7 @@ class GossipSub:
         self.n = n_peers
         self.k = n_slots
         self.m = msg_window
+        self.w = bitpack.n_words(msg_window)
         self.conn_degree = conn_degree
         self.params = params or GossipSubParams()
         self.score_params = score_params or ScoreParams()
@@ -109,8 +173,9 @@ class GossipSub:
 
     def init(self, seed: int = 0) -> GossipState:
         rng = np.random.default_rng(seed)
-        nbrs, rev, valid = build_topology(rng, self.n, self.k, self.conn_degree)
-        n, k, m = self.n, self.k, self.m
+        builder = build_topology if self.n <= 4096 else build_topology_fast
+        nbrs, rev, valid = builder(rng, self.n, self.k, self.conn_degree)
+        n, k, m, w = self.n, self.k, self.m, self.w
         st = GossipState(
             nbrs=jnp.asarray(nbrs, jnp.int32),
             rev=jnp.asarray(rev, jnp.int32),
@@ -120,9 +185,9 @@ class GossipSub:
             counters=TopicCounters.zeros(n, k),
             gcounters=GlobalCounters.zeros(n),
             scores=jnp.zeros((n, k), jnp.float32),
-            have=jnp.zeros((n, m), bool),
-            fresh=jnp.zeros((n, m), bool),
-            gossip_pend=jnp.zeros((n, m), bool),
+            have_w=jnp.zeros((n, w), jnp.uint32),
+            fresh_w=jnp.zeros((n, w), jnp.uint32),
+            gossip_pend_w=jnp.zeros((n, w), jnp.uint32),
             first_step=jnp.full((n, m), -1, jnp.int32),
             msg_valid=jnp.zeros((m,), bool),
             msg_birth=jnp.zeros((m,), jnp.int32),
@@ -132,9 +197,17 @@ class GossipSub:
             step=jnp.asarray(0, jnp.int32),
         )
         # Converge the mesh before traffic: a few warmup heartbeats.
-        for _ in range(3):
-            st = self._heartbeat(st)
-        return st
+        return self._warmup(st)
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def _warmup(self, st: GossipState) -> GossipState:
+        return self._heartbeat(self._heartbeat(self._heartbeat(st)))
+
+    # -- views --------------------------------------------------------------
+
+    def have_bool(self, st: GossipState) -> jax.Array:
+        """Unpacked possession view bool[N, M] (tests / metrics)."""
+        return bitpack.unpack(st.have_w, self.m)
 
     # -- events -------------------------------------------------------------
 
@@ -152,11 +225,13 @@ class GossipSub:
         every receiver — the attack-trace injection point (the reference's
         missing signature hole, ``pubsub.go:117``, made explicit).
         """
-        col_clear_n = jnp.zeros((self.n,), bool)
+        bm = bitpack.bit_mask(slot, self.w)              # u32[W] one-hot
+        have_w = st.have_w & ~bm
+        fresh_w = st.fresh_w & ~bm
         return st._replace(
-            have=st.have.at[:, slot].set(col_clear_n).at[src, slot].set(True),
-            fresh=st.fresh.at[:, slot].set(col_clear_n).at[src, slot].set(True),
-            gossip_pend=st.gossip_pend.at[:, slot].set(col_clear_n),
+            have_w=have_w.at[src].set(have_w[src] | bm),
+            fresh_w=fresh_w.at[src].set(fresh_w[src] | bm),
+            gossip_pend_w=st.gossip_pend_w & ~bm,
             first_step=st.first_step.at[:, slot].set(-1).at[src, slot].set(st.step),
             msg_valid=st.msg_valid.at[slot].set(valid),
             msg_birth=st.msg_birth.at[slot].set(st.step),
@@ -182,21 +257,22 @@ class GossipSub:
         g = scoring_ops.decay_global_counters(st.gcounters, sp)
         scores = scoring_ops.neighbor_scores(c, g, st.nbrs, st.nbr_valid, sp)
 
-        new_mesh, grafted, pruned = gossip_ops.heartbeat_mesh(
+        new_mesh, grafted, pruned = heartbeat_mesh(
             khb, st.mesh, scores, st.nbrs, st.rev, st.nbr_valid, st.alive, p
         )
         c = scoring_ops.on_prune(c, pruned, sp)
         c = scoring_ops.on_graft(c, grafted)
 
-        gossip_pend = st.gossip_pend | gossip_ops.gossip_transfer(
+        gossip_pend_w = st.gossip_pend_w | gossip_ops.gossip_transfer_packed(
             kgossip,
-            st.have,
+            st.have_w,
             new_mesh,
             st.nbrs,
+            st.rev,
             st.nbr_valid,
             st.alive,
             scores,
-            st.msg_valid,
+            bitpack.pack(st.msg_valid),
             p,
             sp.gossip_threshold,
         )
@@ -210,30 +286,36 @@ class GossipSub:
             counters=c,
             gcounters=g,
             scores=scores,
-            gossip_pend=gossip_pend & ~expired[None, :],
+            gossip_pend_w=gossip_pend_w & ~bitpack.pack(expired),
             msg_active=st.msg_active & ~expired,
             key=knext,
         )
 
     def _propagate(self, st: GossipState) -> GossipState:
         # Fold due gossip deliveries into this round's receipts.
-        gossip_new = st.gossip_pend & ~st.have & st.alive[:, None]
-        have = st.have | gossip_new
-        fresh = st.fresh | gossip_new
+        alive_m = jnp.where(st.alive, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
+        gossip_new = st.gossip_pend_w & ~st.have_w & alive_m[:, None]
+        have_w = st.have_w | gossip_new
+        fresh_w = st.fresh_w | gossip_new
         first_step = jnp.where(
-            gossip_new & (st.first_step < 0), st.step, st.first_step
+            bitpack.unpack(gossip_new, self.m) & (st.first_step < 0),
+            st.step,
+            st.first_step,
         )
 
-        out = gossip_ops.propagate(
+        out = gossip_ops.propagate_packed(
             st.mesh,
             st.nbrs,
             st.nbr_valid,
             st.alive,
-            have,
-            fresh,
-            first_step,
-            st.msg_valid & st.msg_active,
+            have_w,
+            fresh_w,
+            bitpack.pack(st.msg_valid & st.msg_active),
+        )
+        first_step = jnp.where(
+            bitpack.unpack(out.new_w, self.m) & (first_step < 0),
             st.step,
+            first_step,
         )
         c = st.counters._replace(
             first_message_deliveries=st.counters.first_message_deliveries
@@ -244,11 +326,11 @@ class GossipSub:
             + out.invalid_inc,
         )
         return st._replace(
-            have=out.have,
-            fresh=out.fresh,
-            first_step=out.first_step,
+            have_w=out.have_w,
+            fresh_w=out.fresh_w,
+            first_step=first_step,
             counters=c,
-            gossip_pend=jnp.zeros_like(st.gossip_pend),
+            gossip_pend_w=jnp.zeros_like(st.gossip_pend_w),
         )
 
     @functools.partial(jax.jit, static_argnums=0)
@@ -282,7 +364,8 @@ class GossipSub:
         propagation latency.
         """
         alive_n = st.alive.sum()
-        delivered = (st.have & st.alive[:, None]).sum(axis=0)  # i32[M]
+        have = self.have_bool(st)
+        delivered = (have & st.alive[:, None]).sum(axis=0)  # i32[M]
         frac = jnp.where(
             st.msg_used & st.msg_valid,
             delivered / jnp.maximum(alive_n, 1),

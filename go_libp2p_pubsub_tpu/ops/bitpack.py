@@ -1,0 +1,85 @@
+"""Bit-packed message windows: M bool flags per peer as ceil(M/32) uint32 words.
+
+The scale enabler for the 100k-peer north star (BASELINE.json config (e)).
+The reference tracks per-peer message state as Go maps and channel buffers
+(`client.go:79`, `subtree.go:17`); the unpacked array form (bool[N, M]) is
+already TPU-shaped, but the propagate hot loop materializes [N, K, M] bool
+cubes — 410 MB of temps per round at N=100k, K=32, M=128.  Packing the
+message axis into uint32 words turns every per-message mask op into a 32-way
+SIMD bitwise op and shrinks the cube 32x: set algebra becomes AND/OR/NOT,
+counting becomes `lax.population_count`, and "which slot delivered first"
+becomes an exclusive cumulative-OR — all VPU-native.
+
+Convention: message m lives in word m // 32, bit m % 32 (little-endian bit
+order, matching `np.unpackbits(bitorder="little")`).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+WORD = 32
+
+
+def n_words(m: int) -> int:
+    """Words needed for an M-message window."""
+    return (m + WORD - 1) // WORD
+
+
+def pack(flags: jax.Array) -> jax.Array:
+    """bool[..., M] -> uint32[..., ceil(M/32)]."""
+    m = flags.shape[-1]
+    w = n_words(m)
+    pad = w * WORD - m
+    if pad:
+        flags = jnp.concatenate(
+            [flags, jnp.zeros(flags.shape[:-1] + (pad,), bool)], axis=-1
+        )
+    bits = flags.reshape(flags.shape[:-1] + (w, WORD)).astype(jnp.uint32)
+    weights = (jnp.uint32(1) << jnp.arange(WORD, dtype=jnp.uint32))
+    return (bits * weights).sum(axis=-1, dtype=jnp.uint32)
+
+
+def unpack(words: jax.Array, m: int) -> jax.Array:
+    """uint32[..., W] -> bool[..., m]."""
+    w = words.shape[-1]
+    shifts = jnp.arange(WORD, dtype=jnp.uint32)
+    bits = (words[..., None] >> shifts) & jnp.uint32(1)
+    flat = bits.reshape(words.shape[:-1] + (w * WORD,))
+    return flat[..., :m].astype(bool)
+
+
+def bit_mask(slot: jax.Array, w: int) -> jax.Array:
+    """One-hot word vector for message index ``slot``: uint32[w] with the
+    slot's bit set.  Traced-index safe (used inside jit for publish)."""
+    word = slot // WORD
+    bit = jnp.uint32(slot % WORD)
+    sel = jnp.arange(w) == word
+    return jnp.where(sel, jnp.uint32(1) << bit, jnp.uint32(0))
+
+
+def popcount(words: jax.Array, axis=-1) -> jax.Array:
+    """Total set bits along ``axis`` (summing word popcounts) as int32."""
+    return jax.lax.population_count(words).astype(jnp.int32).sum(axis=axis)
+
+
+def get_bit(words: jax.Array, slot: int | jax.Array) -> jax.Array:
+    """Read one message bit: words[..., W] -> bool[...]."""
+    word = slot // WORD
+    bit = slot % WORD
+    return ((words[..., word] >> jnp.uint32(bit)) & jnp.uint32(1)).astype(bool)
+
+
+def pack_np(flags: np.ndarray) -> np.ndarray:
+    """NumPy host-side pack (fixture setup without device round-trips)."""
+    m = flags.shape[-1]
+    w = n_words(m)
+    pad = w * WORD - m
+    if pad:
+        flags = np.concatenate(
+            [flags, np.zeros(flags.shape[:-1] + (pad,), bool)], axis=-1
+        )
+    le_bytes = np.packbits(flags, axis=-1, bitorder="little")
+    return le_bytes.reshape(flags.shape[:-1] + (w, 4)).view(np.uint32)[..., 0]

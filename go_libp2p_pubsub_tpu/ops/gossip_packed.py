@@ -1,0 +1,159 @@
+"""Bit-packed GossipSub hot-loop kernels — the 100k-peer scale path.
+
+Same protocol semantics as ``ops/gossip.py`` (the bool-tensor reference
+implementation, equivalence-tested in ``tests/test_gossip_packed.py``), with
+the message window packed into uint32 words (``ops/bitpack.py``):
+
+- ``propagate_packed`` — one eager-push round.  The [N, K, W] word cube is
+  32x smaller than the reference cube; set ops are bitwise AND/OR/NOT,
+  delivery counting is ``lax.population_count``, and first-delivering-slot
+  attribution is an exclusive cumulative-OR over the slot axis
+  (Hillis–Steele, log2 K steps — no serial scan).
+- ``gossip_transfer_packed`` — heartbeat IHAVE/IWANT.  Reformulated from the
+  reference's scatter-add into a **reverse-index gather**: a gossip target is
+  always a slot-paired neighbor, so "peers push to chosen targets" is
+  equivalently "each peer pulls from neighbors whose choice points back at
+  it" via ``chosen[nbrs[t,s], rev[t,s]]``.  Gathers partition cleanly under
+  GSPMD (scatters serialize); this is what lets the sharded 100k-peer sim
+  ride ICI collectives.
+
+The fused-downstream compute (everything after the XLA row gather) also has a
+Pallas TPU kernel form in ``ops/pallas_gossip.py``; these jnp versions are
+the portable reference the kernel is tested against.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config import GossipSubParams
+from .graphs import safe_gather
+
+FULL = jnp.uint32(0xFFFFFFFF)
+
+
+def _as_mask(b: jax.Array) -> jax.Array:
+    """bool[...] -> uint32[...] word mask (all-ones / all-zeros)."""
+    return jnp.where(b, FULL, jnp.uint32(0))
+
+
+def exclusive_or_scan(x: jax.Array, axis: int) -> jax.Array:
+    """Exclusive cumulative bitwise-OR along ``axis`` (log-step prefix)."""
+    k = x.shape[axis]
+    # Shift right by one: before[s] covers strictly-lower slots.
+    zero = jnp.zeros_like(jax.lax.slice_in_dim(x, 0, 1, axis=axis))
+    p = jnp.concatenate(
+        [zero, jax.lax.slice_in_dim(x, 0, k - 1, axis=axis)], axis=axis
+    )
+    sh = 1
+    while sh < k:
+        zeros = jnp.zeros_like(jax.lax.slice_in_dim(x, 0, min(sh, k), axis=axis))
+        shifted = jnp.concatenate(
+            [zeros, jax.lax.slice_in_dim(p, 0, k - sh, axis=axis)], axis=axis
+        )
+        p = p | shifted
+        sh *= 2
+    return p
+
+
+class PropagatePackedOut(NamedTuple):
+    have_w: jax.Array       # u32[N, W]
+    fresh_w: jax.Array      # u32[N, W]
+    new_w: jax.Array        # u32[N, W] first receipts this round (pre-validation)
+    fmd_inc: jax.Array      # f32[N, K]
+    mmd_inc: jax.Array      # f32[N, K]
+    invalid_inc: jax.Array  # f32[N, K]
+
+
+def propagate_packed(
+    mesh: jax.Array,       # bool[N, K]
+    nbrs: jax.Array,       # i32[N, K]
+    nbr_valid: jax.Array,  # bool[N, K]
+    alive: jax.Array,      # bool[N]
+    have_w: jax.Array,     # u32[N, W]
+    fresh_w: jax.Array,    # u32[N, W]
+    valid_w: jax.Array,    # u32[W]  packed (msg_valid & msg_active)
+) -> PropagatePackedOut:
+    """One eager-push round over packed windows.
+
+    Mirrors ``gossip.propagate`` exactly (see its docstring for the protocol
+    rules); ``first_step`` stamping stays with the caller, which knows the
+    step counter and holds the unpacked i32 lattice.
+    """
+    n = nbrs.shape[0]
+
+    j = jnp.clip(nbrs, 0, n - 1)
+    edge_ok = mesh & nbr_valid & safe_gather(alive, nbrs, False)   # bool[N, K]
+    inc = _as_mask(edge_ok)[:, :, None] & fresh_w[j]               # u32[N, K, W]
+
+    before = exclusive_or_scan(inc, axis=1)
+    first_sender = inc & ~before
+
+    arrived = jax.lax.reduce(
+        inc, jnp.uint32(0), jax.lax.bitwise_or, dimensions=(1,)
+    )                                                              # u32[N, W]
+    new_w = arrived & ~have_w & _as_mask(alive)[:, None]
+    newly = first_sender & new_w[:, None, :]
+
+    pc = lambda x: jax.lax.population_count(x).sum(axis=-1).astype(jnp.float32)
+    fmd_inc = pc(newly & valid_w)
+    invalid_inc = pc(newly & ~valid_w)
+    mmd_inc = pc(inc & valid_w)
+
+    return PropagatePackedOut(
+        have_w=have_w | (new_w & valid_w),
+        fresh_w=new_w & valid_w,
+        new_w=new_w,
+        fmd_inc=fmd_inc,
+        mmd_inc=mmd_inc,
+        invalid_inc=invalid_inc,
+    )
+
+
+def gossip_transfer_packed(
+    key: jax.Array,
+    have_w: jax.Array,     # u32[N, W]
+    mesh: jax.Array,       # bool[N, K]
+    nbrs: jax.Array,       # i32[N, K]
+    rev: jax.Array,        # i32[N, K]
+    nbr_valid: jax.Array,  # bool[N, K]
+    alive: jax.Array,      # bool[N]
+    scores: jax.Array,     # f32[N, K]
+    valid_w: jax.Array,    # u32[W]
+    p: GossipSubParams,
+    gossip_threshold: float,
+) -> jax.Array:
+    """Heartbeat IHAVE/IWANT over packed windows -> pending u32[N, W].
+
+    Choice rule is identical to ``gossip.gossip_transfer``: each live peer
+    advertises to ``d_lazy`` random non-mesh, live, above-threshold neighbor
+    slots.  Delivery is computed target-side by the reverse-index gather
+    described in the module docstring.
+    """
+    n, k = nbrs.shape
+    d_lazy = min(p.d_lazy, k)
+    if d_lazy <= 0:
+        return jnp.zeros_like(have_w)
+    eligible = (
+        nbr_valid
+        & ~mesh
+        & safe_gather(alive, nbrs, False)
+        & (scores >= gossip_threshold)
+    )
+    r = jax.random.uniform(key, (n, k))
+    r = jnp.where(eligible, r, -1.0)
+    thresh = -jnp.sort(-r, axis=1)[:, d_lazy - 1][:, None]
+    chosen = eligible & (r >= thresh) & (r > 0)
+
+    # Target side: neighbor j = nbrs[t, s] chose me iff chosen[j, rev[t, s]].
+    jidx = jnp.clip(nbrs, 0, n - 1)
+    ridx = jnp.clip(rev, 0, k - 1)
+    towards_me = chosen[jidx, ridx] & nbr_valid                    # bool[N, K]
+    offered = _as_mask(towards_me)[:, :, None] & have_w[jidx]      # u32[N, K, W]
+    offered = jax.lax.reduce(
+        offered, jnp.uint32(0), jax.lax.bitwise_or, dimensions=(1,)
+    )
+    return offered & ~have_w & valid_w & _as_mask(alive)[:, None]

@@ -23,6 +23,8 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..ops import bitpack
+
 
 # ---------------------------------------------------------------------------
 # device-side reductions
@@ -59,7 +61,8 @@ def gossip_metrics(st) -> Dict[str, jax.Array]:
     alive_n = jnp.maximum(alive.sum(), 1)
     mesh_deg = (st.mesh & st.nbr_valid).sum(axis=1)
     in_window = st.msg_used & st.msg_valid
-    delivered = (st.have & alive[:, None]).sum(axis=0)
+    have = bitpack.unpack(st.have_w, st.msg_valid.shape[0])
+    delivered = (have & alive[:, None]).sum(axis=0)
     frac = jnp.where(in_window, delivered / alive_n, jnp.nan)
     scores_live = jnp.where(st.nbr_valid, st.scores, jnp.nan)
     return {
@@ -68,10 +71,10 @@ def gossip_metrics(st) -> Dict[str, jax.Array]:
         "mesh_degree_max": mesh_deg.max(),
         "msgs_in_window": in_window.sum(),
         "delivery_frac_mean": jnp.nanmean(frac),
-        "deliveries_total": (st.have & alive[:, None] & in_window[None, :]).sum(),
+        "deliveries_total": (have & alive[:, None] & in_window[None, :]).sum(),
         "score_mean": jnp.nanmean(scores_live),
         "score_min": jnp.nanmin(scores_live),
-        "gossip_pending": st.gossip_pend.sum(),
+        "gossip_pending": bitpack.popcount(st.gossip_pend_w).sum(),
         "step": st.step,
     }
 

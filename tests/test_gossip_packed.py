@@ -1,0 +1,153 @@
+"""Packed gossip kernels must be bit-exact with the unpacked reference ops."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu.config import GossipSubParams
+from go_libp2p_pubsub_tpu.models.gossipsub import (
+    build_topology,
+    build_topology_fast,
+)
+from go_libp2p_pubsub_tpu.ops import bitpack
+from go_libp2p_pubsub_tpu.ops import gossip as ref_ops
+from go_libp2p_pubsub_tpu.ops import gossip_packed as packed_ops
+
+
+@pytest.mark.parametrize("m", [1, 31, 32, 33, 96, 128])
+def test_pack_unpack_roundtrip(m):
+    rng = np.random.default_rng(m)
+    flags = rng.random((17, m)) < 0.3
+    words = bitpack.pack(jnp.asarray(flags))
+    assert words.shape == (17, bitpack.n_words(m))
+    back = np.asarray(bitpack.unpack(words, m))
+    np.testing.assert_array_equal(back, flags)
+    # Padding bits beyond m stay zero (counters rely on this invariant).
+    full = np.asarray(bitpack.unpack(words, bitpack.n_words(m) * 32))
+    assert not full[:, m:].any()
+
+
+def test_pack_np_matches_device_pack():
+    rng = np.random.default_rng(0)
+    flags = rng.random((5, 70)) < 0.5
+    np.testing.assert_array_equal(
+        bitpack.pack_np(flags), np.asarray(bitpack.pack(jnp.asarray(flags)))
+    )
+
+
+def test_bit_mask_and_get_bit():
+    w = 4
+    for slot in [0, 31, 32, 95, 127]:
+        bm = np.asarray(bitpack.bit_mask(jnp.int32(slot), w))
+        flags = np.asarray(bitpack.unpack(jnp.asarray(bm), w * 32))
+        assert flags.sum() == 1 and flags[slot]
+        assert bool(bitpack.get_bit(jnp.asarray(bm), slot))
+
+
+def _random_state(seed, n=64, k=16, m=96, degree=8):
+    rng = np.random.default_rng(seed)
+    nbrs, rev, valid = build_topology(rng, n, k, degree)
+    mesh = valid & (rng.random((n, k)) < 0.6)
+    # Symmetrize mesh over the rev pairing.
+    j = np.clip(nbrs, 0, n - 1)
+    mesh = mesh & mesh[j, np.clip(rev, 0, k - 1)]
+    alive = rng.random(n) < 0.9
+    have = rng.random((n, m)) < 0.2
+    fresh = have & (rng.random((n, m)) < 0.5)
+    msg_valid = rng.random(m) < 0.8
+    return (
+        jnp.asarray(mesh),
+        jnp.asarray(nbrs, jnp.int32),
+        jnp.asarray(rev, jnp.int32),
+        jnp.asarray(valid),
+        jnp.asarray(alive),
+        jnp.asarray(have),
+        jnp.asarray(fresh),
+        jnp.asarray(msg_valid),
+    )
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_propagate_packed_matches_reference(seed):
+    mesh, nbrs, rev, valid, alive, have, fresh, msg_valid = _random_state(seed)
+    n, m = have.shape
+    first_step = jnp.full((n, m), -1, jnp.int32)
+    step = jnp.int32(7)
+
+    ref = ref_ops.propagate(
+        mesh, nbrs, valid, alive, have, fresh, first_step, msg_valid, step
+    )
+    out = packed_ops.propagate_packed(
+        mesh, nbrs, valid, alive,
+        bitpack.pack(have), bitpack.pack(fresh), bitpack.pack(msg_valid),
+    )
+
+    np.testing.assert_array_equal(
+        np.asarray(bitpack.unpack(out.have_w, m)), np.asarray(ref.have)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(bitpack.unpack(out.fresh_w, m)), np.asarray(ref.fresh)
+    )
+    np.testing.assert_allclose(np.asarray(out.fmd_inc), np.asarray(ref.fmd_inc))
+    np.testing.assert_allclose(np.asarray(out.mmd_inc), np.asarray(ref.mmd_inc))
+    np.testing.assert_allclose(
+        np.asarray(out.invalid_inc), np.asarray(ref.invalid_inc)
+    )
+    # first_step stamping (caller-side in the packed path) matches too.
+    stamped = jnp.where(
+        bitpack.unpack(out.new_w, m) & (first_step < 0), step, first_step
+    )
+    np.testing.assert_array_equal(np.asarray(stamped), np.asarray(ref.first_step))
+
+
+@pytest.mark.parametrize("seed", [0, 3])
+def test_gossip_transfer_packed_matches_reference(seed):
+    mesh, nbrs, rev, valid, alive, have, fresh, msg_valid = _random_state(seed)
+    n, m = have.shape
+    k = nbrs.shape[1]
+    scores = jnp.asarray(np.random.default_rng(seed).normal(0, 1, (n, k)).astype(np.float32))
+    p = GossipSubParams(d_lazy=4)
+    key = jax.random.PRNGKey(seed)
+
+    ref = ref_ops.gossip_transfer(
+        key, have, mesh, nbrs, valid, alive, scores, msg_valid, p, -0.5
+    )
+    out = packed_ops.gossip_transfer_packed(
+        key, bitpack.pack(have), mesh, nbrs, rev, valid, alive, scores,
+        bitpack.pack(msg_valid), p, -0.5,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(bitpack.unpack(out, m)), np.asarray(ref)
+    )
+
+
+def test_gossip_transfer_packed_disabled_when_d_lazy_zero():
+    mesh, nbrs, rev, valid, alive, have, fresh, msg_valid = _random_state(1)
+    out = packed_ops.gossip_transfer_packed(
+        jax.random.PRNGKey(0), bitpack.pack(have), mesh, nbrs, rev, valid,
+        alive, jnp.zeros_like(nbrs, jnp.float32), bitpack.pack(msg_valid),
+        GossipSubParams(d_lazy=0), -10.0,
+    )
+    assert not bool(np.asarray(out).any())
+
+
+def test_build_topology_fast_invariants():
+    rng = np.random.default_rng(11)
+    n, k, degree = 512, 24, 12
+    nbrs, rev, valid = build_topology_fast(rng, n, k, degree)
+    # Slot pairing is symmetric: my slot's remote points back at me.
+    for i in range(0, n, 37):
+        for s in range(k):
+            if not valid[i, s]:
+                continue
+            j, r = nbrs[i, s], rev[i, s]
+            assert nbrs[j, r] == i and rev[j, r] == s
+    # No self-loops, no duplicate neighbors per peer.
+    for i in range(0, n, 13):
+        ns = nbrs[i][valid[i]]
+        assert (ns != i).all()
+        assert len(set(ns.tolist())) == len(ns)
+    deg = valid.sum(axis=1)
+    assert deg.mean() > degree * 0.7
+    assert deg.max() <= k

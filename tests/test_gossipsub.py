@@ -58,7 +58,7 @@ def test_publish_reaches_everyone(gs, st0):
 def test_invalid_message_not_relayed_and_penalized(gs, st0):
     st = gs.publish(st0, jnp.int32(0), jnp.int32(0), jnp.asarray(False))
     st = gs.run(st, 24)
-    have = np.asarray(st.have[:, 0])
+    have = np.asarray(gs.have_bool(st)[:, 0])
     # Only the origin and its mesh neighbors ever saw it: the first hop
     # receives, fails validation, and does not relay.
     assert have.sum() <= 1 + gs.params.d_hi
@@ -122,7 +122,7 @@ def test_gossip_recovers_nonmesh_peers(gs, st0):
     # Run shy of a heartbeat: eager push cannot reach 5 (no mesh links), so
     # either gossip already delivered or it is still missing.
     st = gs.run(st, 4 * gs.heartbeat_steps)
-    assert bool(st.have[5, 2]), "gossip should deliver to meshless peer"
+    assert bool(gs.have_bool(st)[5, 2]), "gossip should deliver to meshless peer"
 
 
 def test_fmd_counters_track_deliveries(gs, st0):

@@ -171,7 +171,7 @@ class TestFaults:
         plan.kills[2] = kill
         out = faults.run_with_faults(st, 32, gs.run, plan, gs.kill_peers)
         assert int(np.asarray(out.alive).sum()) == 54
-        have = np.asarray(out.have[:, 0])
+        have = np.asarray(gs.have_bool(out)[:, 0])
         alive = np.asarray(out.alive)
         assert have[alive].all(), "all survivors must still get the message"
 
