@@ -161,6 +161,7 @@ class GossipSub:
         params: Optional[GossipSubParams] = None,
         score_params: Optional[ScoreParams] = None,
         heartbeat_steps: int = 8,
+        use_pallas: Optional[bool] = None,
     ):
         self.n = n_peers
         self.k = n_slots
@@ -170,6 +171,15 @@ class GossipSub:
         self.params = params or GossipSubParams()
         self.score_params = score_params or ScoreParams()
         self.heartbeat_steps = heartbeat_steps
+        # Pallas fast path: unsharded TPU arrays only.  The jnp ops partition
+        # under GSPMD for the peer-sharded sim (see parallel/), while a
+        # pallas_call would need shard_map — sharded runners must pass
+        # use_pallas=False.  Mosaic lowering is TPU-only, so other backends
+        # auto-pick the jnp path; explicit True off-TPU runs the kernel in
+        # the Pallas interpreter (slow; test path).
+        if use_pallas is None:
+            use_pallas = jax.default_backend() == "tpu"
+        self.use_pallas = use_pallas
 
     def init(self, seed: int = 0) -> GossipState:
         rng = np.random.default_rng(seed)
@@ -293,8 +303,9 @@ class GossipSub:
 
     def _propagate(self, st: GossipState) -> GossipState:
         # Fold due gossip deliveries into this round's receipts.
-        alive_m = jnp.where(st.alive, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
-        gossip_new = st.gossip_pend_w & ~st.have_w & alive_m[:, None]
+        gossip_new = (
+            st.gossip_pend_w & ~st.have_w & gossip_ops._as_mask(st.alive)[:, None]
+        )
         have_w = st.have_w | gossip_new
         fresh_w = st.fresh_w | gossip_new
         first_step = jnp.where(
@@ -303,15 +314,19 @@ class GossipSub:
             st.first_step,
         )
 
-        out = gossip_ops.propagate_packed(
-            st.mesh,
-            st.nbrs,
-            st.nbr_valid,
-            st.alive,
-            have_w,
-            fresh_w,
-            bitpack.pack(st.msg_valid & st.msg_active),
-        )
+        valid_w = bitpack.pack(st.msg_valid & st.msg_active)
+        if self.use_pallas:
+            from ..ops.pallas_gossip import propagate_packed_pallas
+
+            out = propagate_packed_pallas(
+                st.mesh, st.nbrs, st.nbr_valid, st.alive, have_w, fresh_w,
+                valid_w, interpret=jax.default_backend() != "tpu",
+            )
+        else:
+            out = gossip_ops.propagate_packed(
+                st.mesh, st.nbrs, st.nbr_valid, st.alive, have_w, fresh_w,
+                valid_w,
+            )
         first_step = jnp.where(
             bitpack.unpack(out.new_w, self.m) & (first_step < 0),
             st.step,
