@@ -1,0 +1,137 @@
+"""ICI-sharded GossipSub: the 100k-peer epidemic sim over a device mesh.
+
+BASELINE.json config (e): "100k-peer ICI-sharded epidemic sim".  The
+reference scales peer count with processes and sockets (SURVEY.md §5.8);
+here the scaling axis is the peer dimension of the ``GossipState`` arrays,
+sharded across a 1-D ``jax.sharding.Mesh`` with ``NamedSharding``.  XLA
+GSPMD partitions the jitted step: the neighbor row gather ``fresh_w[nbrs]``
+and the reverse-index gathers become all-to-all / collective-permute traffic
+on ICI — peers on different shards exchanging message words is the array
+form of cross-host streams.
+
+Why this module exists instead of reusing ``mesh.state_shardings`` directly:
+``GossipState`` mixes peer-dim arrays ([N, ...]: adjacency, windows, scores)
+with message-window arrays ([M] metadata) and scalars; only dim-0==N arrays
+shard, the rest replicate.  The generic helper would shard anything with a
+leading dim.
+
+The sharded path uses the portable jnp kernels (``ops/gossip_packed``) —
+``use_pallas=False`` is forced; a pallas_call does not partition under GSPMD
+(it would need shard_map; see ``ops/pallas_gossip``).
+
+Works identically on a real TPU slice and on the virtual
+``--xla_force_host_platform_device_count`` CPU mesh used by the tests and
+the driver's multi-chip dry run.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.gossipsub import GossipState, GossipSub
+from .mesh import PEER_AXIS, make_mesh
+
+
+def gossip_state_shardings(
+    st: GossipState, mesh: Mesh, n_peers: int, axis: str = PEER_AXIS
+):
+    """NamedSharding pytree for a ``GossipState``: arrays with a leading
+    peer dim shard over ``axis``; message metadata and scalars replicate."""
+    n_dev = mesh.shape[axis]
+    if n_peers % n_dev != 0:
+        raise ValueError(
+            f"n_peers ({n_peers}) must divide by mesh axis size ({n_dev})"
+        )
+
+    def one(x):
+        if getattr(x, "ndim", 0) >= 1 and x.shape[0] == n_peers:
+            return NamedSharding(mesh, P(axis, *([None] * (x.ndim - 1))))
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(one, st)
+
+
+class ShardedGossipSub:
+    """A ``GossipSub`` whose state and step are pinned to a device mesh.
+
+    Usage::
+
+        sg = ShardedGossipSub(n_peers=98304, n_devices=8)
+        st = sg.init(seed=0)            # device_put with peer-dim sharding
+        st = sg.publish(st, src, slot, valid)
+        st = sg.run(st, 64)             # GSPMD-partitioned rollout
+    """
+
+    def __init__(
+        self,
+        n_peers: int,
+        n_devices: Optional[int] = None,
+        mesh: Optional[Mesh] = None,
+        **gossip_kwargs,
+    ):
+        if "use_pallas" in gossip_kwargs and gossip_kwargs["use_pallas"]:
+            raise ValueError("pallas path does not shard; use_pallas must be False")
+        gossip_kwargs["use_pallas"] = False
+        self.model = GossipSub(n_peers=n_peers, **gossip_kwargs)
+        self.mesh = mesh if mesh is not None else make_mesh(n_devices)
+        self.n_devices = self.mesh.shape[PEER_AXIS]
+        if n_peers % self.n_devices != 0:
+            raise ValueError(
+                f"n_peers ({n_peers}) must divide by device count "
+                f"({self.n_devices})"
+            )
+        self._jitted = {}
+
+    # -- state placement ----------------------------------------------------
+
+    def shardings(self, st: GossipState):
+        return gossip_state_shardings(st, self.mesh, self.model.n)
+
+    def init(self, seed: int = 0) -> GossipState:
+        st = self.model.init(seed)
+        return jax.device_put(st, self.shardings(st))
+
+    # -- sharded ops --------------------------------------------------------
+
+    def _pin(self, name, fn, st, extra_in=()):
+        """jit ``fn`` with state in/out shardings pinned (cached per name)."""
+        if name not in self._jitted:
+            sh = self.shardings(st)
+            repl = NamedSharding(self.mesh, P())
+            self._jitted[name] = jax.jit(
+                fn,
+                in_shardings=(sh,) + tuple(repl for _ in extra_in),
+                out_shardings=sh,
+                static_argnums=(),
+            )
+        return self._jitted[name]
+
+    def publish(self, st, src, slot, valid) -> GossipState:
+        f = self._pin(
+            "publish",
+            lambda s, a, b, c: self.model.publish(s, a, b, c),
+            st,
+            extra_in=(0, 1, 2),
+        )
+        return f(st, src, slot, valid)
+
+    def step(self, st: GossipState) -> GossipState:
+        return self._pin("step", lambda s: self.model.step(s), st)(st)
+
+    def run(self, st: GossipState, n_steps: int) -> GossipState:
+        f = self._pin(
+            f"run{n_steps}", lambda s: self.model.run(s, n_steps), st
+        )
+        return f(st)
+
+    def kill_peers(self, st, mask) -> GossipState:
+        f = self._pin(
+            "kill", lambda s, m: self.model.kill_peers(s, m), st, extra_in=(0,)
+        )
+        return f(st, mask)
+
+    def delivery_stats(self, st: GossipState):
+        return self.model.delivery_stats(st)
