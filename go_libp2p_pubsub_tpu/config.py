@@ -121,6 +121,7 @@ class GossipSubParams:
     opportunistic_graft_peers: int = 2
     max_ihave_length: int = 5000
     seen_ttl_s: float = 120.0
+    prune_backoff_heartbeats: int = 4  # spec's PruneBackoff, in heartbeats
 
     def __post_init__(self) -> None:
         if not (self.d_lo <= self.d <= self.d_hi):

@@ -1,0 +1,207 @@
+"""Attack traces: scripted adversary scenarios over the GossipSub sim.
+
+BASELINE.json config (d): "peer-scoring refresh under sybil/eclipse attack
+traces".  The v0 reference has no adversary model at all — no signing
+(``pubsub.go:117``), no validation, no scoring — so these scenarios encode
+the capability envelope: each one drives the simulator with an adversary
+schedule and records a per-step defense time series, all device-side (the
+rollout is one ``lax.scan``; metrics are reduced in-scan, not on host).
+
+Scenarios:
+- **invalid spam** — attackers flood invalid messages (failed validation);
+  P4 penalties must evict them from every honest mesh.
+- **sybil colocation** — many attacker identities share one IP group; the
+  P6 colocation penalty must keep them un-grafted regardless of conduct.
+- **eclipse attempt** — attackers start fully occupying a target's mesh
+  slots and go silent; P3 delivery-deficit penalties must rotate them out
+  and restore the target's delivery.
+
+Each runner returns ``(final_state, report)`` where ``report`` maps metric
+name -> per-step array (host numpy), ready for assertions or plotting.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .gossipsub import GossipState, GossipSub
+
+
+def _attacker_metrics(
+    gs: GossipSub, st: GossipState, attackers: jax.Array
+) -> Dict[str, jax.Array]:
+    """In-scan reductions: adversary mesh occupancy + score standing."""
+    n = gs.n
+    att_slot = st.nbr_valid & attackers[jnp.clip(st.nbrs, 0, n - 1)]
+    honest = ~attackers & st.alive
+    in_honest_mesh = (st.mesh & att_slot & honest[:, None]).sum()
+    att_scores = jnp.where(att_slot, st.scores, jnp.nan)
+    return {
+        "attacker_mesh_edges": in_honest_mesh.astype(jnp.int32),
+        "attacker_score_mean": jnp.nanmean(att_scores),
+        "honest_score_min": jnp.nanmin(
+            jnp.where(
+                st.nbr_valid & ~att_slot & jnp.isfinite(st.scores),
+                st.scores,
+                jnp.nan,
+            )
+        ),
+    }
+
+
+def run_with_metrics(
+    gs: GossipSub,
+    st: GossipState,
+    n_steps: int,
+    attackers: jax.Array,
+) -> Tuple[GossipState, Dict[str, np.ndarray]]:
+    """Roll ``n_steps`` collecting the defense time series each step."""
+
+    def body(s, _):
+        s = gs.step(s)
+        return s, _attacker_metrics(gs, s, attackers)
+
+    st, series = jax.lax.scan(body, st, None, length=n_steps)
+    return st, {k: np.asarray(v) for k, v in jax.device_get(series).items()}
+
+
+# ---------------------------------------------------------------------------
+# scenarios
+# ---------------------------------------------------------------------------
+
+
+def invalid_spam_attack(
+    gs: GossipSub,
+    st: GossipState,
+    n_attackers: int,
+    n_rounds: int = 6,
+    steps_per_round: int = 4,
+    seed: int = 0,
+) -> Tuple[GossipState, Dict[str, np.ndarray], jax.Array]:
+    """Attackers (peers 0..n_attackers-1) publish invalid messages each
+    round; honest traffic continues from random publishers."""
+    if n_attackers > gs.m // 2:
+        raise ValueError(
+            f"n_attackers ({n_attackers}) exceeds the publishable window "
+            f"(msg_window // 2 = {gs.m // 2}); grow msg_window or shrink "
+            "the attacker set — clamping silently would model a smaller "
+            "attack than reported"
+        )
+    attackers = jnp.arange(gs.n) < n_attackers
+    rng = np.random.default_rng(seed)
+    series = []
+    slot = 0
+    for _ in range(n_rounds):
+        # Every attacker seeds one invalid message; one honest publish too.
+        for a in range(n_attackers):
+            st = gs.publish(
+                st,
+                jnp.int32(a),
+                jnp.int32(slot % gs.m),
+                jnp.asarray(False),
+            )
+            slot += 1
+        st = gs.publish(
+            st,
+            jnp.int32(int(rng.integers(n_attackers, gs.n))),
+            jnp.int32(slot % gs.m),
+            jnp.asarray(True),
+        )
+        slot += 1
+        st, s = run_with_metrics(gs, st, steps_per_round, attackers)
+        series.append(s)
+    report = {
+        k: np.concatenate([s[k] for s in series]) for k in series[0]
+    }
+    return st, report, attackers
+
+
+def sybil_colocation_attack(
+    gs: GossipSub,
+    st: GossipState,
+    n_sybils: int,
+    n_steps: int = 32,
+) -> Tuple[GossipState, Dict[str, np.ndarray], jax.Array]:
+    """Sybil identities (peers 0..n_sybils-1) share one colocation group;
+    the P6 penalty (``ops/scoring.colocation_penalty``) is the defense."""
+    attackers = jnp.arange(gs.n) < n_sybils
+    group = np.asarray(st.gcounters.ip_group).copy()
+    group[:n_sybils] = 0
+    st = st._replace(
+        gcounters=st.gcounters._replace(ip_group=jnp.asarray(group))
+    )
+    st, report = run_with_metrics(gs, st, n_steps, attackers)
+    return st, report, attackers
+
+
+def eclipse_attempt(
+    gs: GossipSub,
+    st: GossipState,
+    target: int,
+    n_rounds: int = 8,
+    msgs_per_round: int = 2,
+    seed: int = 0,
+) -> Tuple[GossipState, Dict[str, np.ndarray], jax.Array]:
+    """The target's entire converged mesh turns adversarial and goes silent
+    (receives but never relays): an eclipse — the target's data-plane view
+    is fully attacker-controlled.  With P3 (mesh-delivery deficit) enabled
+    in the model's score params and honest background traffic flowing, the
+    silent slots build delivery deficits, get pruned (and held out by the
+    prune backoff), and honest grafts restore the target's connectivity.
+
+    Each round publishes ``msgs_per_round`` valid messages from random
+    honest peers, then advances one heartbeat period with attacker relay
+    suppressed (their fresh words are zeroed after every step — alive and
+    scoreable, but mute).
+    """
+    n, k = gs.n, gs.k
+    nbrs_np = np.asarray(st.nbrs)
+    mesh_np = np.asarray(st.mesh)
+    att_ids = sorted(
+        {int(nbrs_np[target, s]) for s in range(k) if mesh_np[target, s]}
+    )
+    attackers = jnp.zeros((n,), bool).at[jnp.asarray(att_ids)].set(True)
+    honest_ids = np.array(
+        [i for i in range(n) if i not in att_ids and i != target]
+    )
+    silence = jnp.where(
+        attackers[:, None], jnp.uint32(0), jnp.uint32(0xFFFFFFFF)
+    )
+
+    def body(s, _):
+        s = gs.step(s)
+        # Attacker silence: drop anything they would relay next round.
+        s = s._replace(fresh_w=s.fresh_w & silence)
+        m = _attacker_metrics(gs, s, attackers)
+        # Target-centric defense metric: mesh edges to honest peers.
+        tgt_honest = (
+            s.mesh[target]
+            & s.nbr_valid[target]
+            & ~attackers[jnp.clip(s.nbrs[target], 0, n - 1)]
+        ).sum()
+        m["target_honest_mesh_edges"] = tgt_honest.astype(jnp.int32)
+        return s, m
+
+    rng = np.random.default_rng(seed)
+    series = []
+    slot = 0
+    for _ in range(n_rounds):
+        for _ in range(msgs_per_round):
+            st = gs.publish(
+                st,
+                jnp.int32(int(rng.choice(honest_ids))),
+                jnp.int32(slot % gs.m),
+                jnp.asarray(True),
+            )
+            slot += 1
+        st, s = jax.lax.scan(body, st, None, length=gs.heartbeat_steps)
+        series.append(jax.device_get(s))
+    report = {
+        k_: np.concatenate([np.asarray(s[k_]) for s in series])
+        for k_ in series[0]
+    }
+    return st, report, attackers

@@ -48,6 +48,7 @@ class GossipState(NamedTuple):
     nbr_valid: jax.Array    # bool[N, K]
     alive: jax.Array        # bool[N]
     mesh: jax.Array         # bool[N, K] symmetric mesh membership
+    backoff: jax.Array      # i32[N, K] prune-backoff heartbeats remaining
     counters: TopicCounters     # per-slot topic score counters
     gcounters: GlobalCounters   # per-peer global score inputs
     scores: jax.Array       # f32[N, K] cached neighbor scores (last heartbeat)
@@ -149,6 +150,29 @@ def build_topology_fast(
     return nbrs, rev, nbrs >= 0
 
 
+def seed_message(
+    have_w, fresh_w, gossip_pend_w, first_step,
+    msg_valid, msg_birth, msg_active, msg_used,
+    src, slot, valid, step, w,
+):
+    """Window-slot recycle + seed, shared by the single- and multi-topic
+    models: clear the slot's bits for ALL peers (slot reuse), then stamp the
+    publisher.  Returns the eight updated window leaves in argument order."""
+    bm = bitpack.bit_mask(slot, w)               # u32[W] one-hot
+    have_w = have_w & ~bm
+    fresh_w = fresh_w & ~bm
+    return (
+        have_w.at[src].set(have_w[src] | bm),
+        fresh_w.at[src].set(fresh_w[src] | bm),
+        gossip_pend_w & ~bm,
+        first_step.at[:, slot].set(-1).at[src, slot].set(step),
+        msg_valid.at[slot].set(valid),
+        msg_birth.at[slot].set(step),
+        msg_active.at[slot].set(True),
+        msg_used.at[slot].set(True),
+    )
+
+
 class GossipSub:
     """Single-topic GossipSub simulator with static shapes."""
 
@@ -181,17 +205,28 @@ class GossipSub:
             use_pallas = jax.default_backend() == "tpu"
         self.use_pallas = use_pallas
 
-    def init(self, seed: int = 0) -> GossipState:
+    def build_graph(self, seed: int = 0):
+        """Connection topology only -> (nbrs, rev, nbr_valid) as jnp arrays
+        (the loop builder is exact for small N; the vectorized one scales)."""
         rng = np.random.default_rng(seed)
         builder = build_topology if self.n <= 4096 else build_topology_fast
         nbrs, rev, valid = builder(rng, self.n, self.k, self.conn_degree)
+        return (
+            jnp.asarray(nbrs, jnp.int32),
+            jnp.asarray(rev, jnp.int32),
+            jnp.asarray(valid),
+        )
+
+    def init(self, seed: int = 0) -> GossipState:
+        nbrs, rev, valid = self.build_graph(seed)
         n, k, m, w = self.n, self.k, self.m, self.w
         st = GossipState(
-            nbrs=jnp.asarray(nbrs, jnp.int32),
-            rev=jnp.asarray(rev, jnp.int32),
-            nbr_valid=jnp.asarray(valid),
+            nbrs=nbrs,
+            rev=rev,
+            nbr_valid=valid,
             alive=jnp.ones((n,), bool),
             mesh=jnp.zeros((n, k), bool),
+            backoff=jnp.zeros((n, k), jnp.int32),
             counters=TopicCounters.zeros(n, k),
             gcounters=GlobalCounters.zeros(n),
             scores=jnp.zeros((n, k), jnp.float32),
@@ -235,18 +270,16 @@ class GossipSub:
         every receiver — the attack-trace injection point (the reference's
         missing signature hole, ``pubsub.go:117``, made explicit).
         """
-        bm = bitpack.bit_mask(slot, self.w)              # u32[W] one-hot
-        have_w = st.have_w & ~bm
-        fresh_w = st.fresh_w & ~bm
+        (have_w, fresh_w, pend_w, first_step,
+         mv, mb, ma, mu) = seed_message(
+            st.have_w, st.fresh_w, st.gossip_pend_w, st.first_step,
+            st.msg_valid, st.msg_birth, st.msg_active, st.msg_used,
+            src, slot, valid, st.step, self.w,
+        )
         return st._replace(
-            have_w=have_w.at[src].set(have_w[src] | bm),
-            fresh_w=fresh_w.at[src].set(fresh_w[src] | bm),
-            gossip_pend_w=st.gossip_pend_w & ~bm,
-            first_step=st.first_step.at[:, slot].set(-1).at[src, slot].set(st.step),
-            msg_valid=st.msg_valid.at[slot].set(valid),
-            msg_birth=st.msg_birth.at[slot].set(st.step),
-            msg_active=st.msg_active.at[slot].set(True),
-            msg_used=st.msg_used.at[slot].set(True),
+            have_w=have_w, fresh_w=fresh_w, gossip_pend_w=pend_w,
+            first_step=first_step, msg_valid=mv, msg_birth=mb,
+            msg_active=ma, msg_used=mu,
         )
 
     @functools.partial(jax.jit, static_argnums=0)
@@ -267,8 +300,9 @@ class GossipSub:
         g = scoring_ops.decay_global_counters(st.gcounters, sp)
         scores = scoring_ops.neighbor_scores(c, g, st.nbrs, st.nbr_valid, sp)
 
-        new_mesh, grafted, pruned = heartbeat_mesh(
-            khb, st.mesh, scores, st.nbrs, st.rev, st.nbr_valid, st.alive, p
+        new_mesh, grafted, pruned, backoff = heartbeat_mesh(
+            khb, st.mesh, scores, st.nbrs, st.rev, st.nbr_valid, st.alive, p,
+            st.backoff,
         )
         c = scoring_ops.on_prune(c, pruned, sp)
         c = scoring_ops.on_graft(c, grafted)
@@ -293,6 +327,7 @@ class GossipSub:
         )
         return st._replace(
             mesh=new_mesh,
+            backoff=backoff,
             counters=c,
             gcounters=g,
             scores=scores,

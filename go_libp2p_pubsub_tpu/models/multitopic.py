@@ -1,0 +1,322 @@
+"""Multi-topic GossipSub: T independent meshes over one shared topology.
+
+The reference keys everything by topic: one protocol registration and one
+tree per ``(root, title)`` (``pubsub.go:55``, ``client.go:68``); peers join
+topics independently.  The TPU-native form stacks the per-topic state with a
+leading topic axis and ``jax.vmap``s the single-topic kernels over it:
+
+- **shared across topics**: connection topology (``nbrs``/``rev``/
+  ``nbr_valid``), liveness, global score counters (P5-P7 are per-peer, not
+  per-topic), and the cached aggregate score;
+- **per-topic** (leading ``T`` dim): mesh membership, topic score counters,
+  packed message windows, message metadata, PRNG keys.
+
+Scoring follows the v1.1 aggregation rule: a neighbor's score is the SUM of
+its per-topic components across all topics plus the global components —
+misbehaving in one topic (invalid spam, delivery deficits) degrades the
+attacker's standing in every topic's mesh, which is the cross-topic defense
+the spec's design intends.  Subscription is a per-(topic, peer) mask folded
+into the topic's liveness view: unsubscribed peers neither receive nor relay
+nor get grafted in that topic.
+
+Uses the portable jnp kernels (vmap over a ``pallas_call`` is left out of
+scope; ``use_pallas`` stays False internally).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import GossipSubParams, ScoreParams
+from ..ops import bitpack
+from ..ops import gossip_packed as gossip_ops
+from ..ops import scoring as scoring_ops
+from ..ops.gossip import heartbeat_mesh
+from ..ops.scoring import GlobalCounters, TopicCounters
+from .gossipsub import GossipState, GossipSub
+
+
+class MultiTopicState(NamedTuple):
+    # shared
+    nbrs: jax.Array          # i32[N, K]
+    rev: jax.Array           # i32[N, K]
+    nbr_valid: jax.Array     # bool[N, K]
+    alive: jax.Array         # bool[N]
+    subscribed: jax.Array    # bool[T, N]
+    gcounters: GlobalCounters    # per-peer [N]
+    scores: jax.Array        # f32[N, K] aggregate (cached at heartbeat)
+    # per-topic (leading T)
+    mesh: jax.Array          # bool[T, N, K]
+    backoff: jax.Array       # i32[T, N, K] prune-backoff (per topic, per spec)
+    counters: TopicCounters  # f32[T, N, K] leaves
+    have_w: jax.Array        # u32[T, N, W]
+    fresh_w: jax.Array       # u32[T, N, W]
+    gossip_pend_w: jax.Array # u32[T, N, W]
+    first_step: jax.Array    # i32[T, N, M]
+    msg_valid: jax.Array     # bool[T, M]
+    msg_birth: jax.Array     # i32[T, M]
+    msg_active: jax.Array    # bool[T, M]
+    msg_used: jax.Array      # bool[T, M]
+    keys: jax.Array          # u32[T, 2] per-topic PRNG keys
+    step: jax.Array          # i32
+
+
+class MultiTopicGossipSub:
+    """T-topic GossipSub simulator sharing one connection graph."""
+
+    def __init__(
+        self,
+        n_topics: int = 4,
+        n_peers: int = 1024,
+        n_slots: int = 32,
+        conn_degree: int = 16,
+        msg_window: int = 128,
+        params: Optional[GossipSubParams] = None,
+        score_params: Optional[ScoreParams] = None,
+        heartbeat_steps: int = 8,
+    ):
+        self.t = n_topics
+        self.gs = GossipSub(
+            n_peers=n_peers,
+            n_slots=n_slots,
+            conn_degree=conn_degree,
+            msg_window=msg_window,
+            params=params,
+            score_params=score_params,
+            heartbeat_steps=heartbeat_steps,
+            use_pallas=False,
+        )
+        self.n, self.k, self.m, self.w = (
+            self.gs.n, self.gs.k, self.gs.m, self.gs.w,
+        )
+        self.params = self.gs.params
+        self.score_params = self.gs.score_params
+        self.heartbeat_steps = heartbeat_steps
+
+    # -- construction -------------------------------------------------------
+
+    def init(
+        self, seed: int = 0, subscribed: Optional[np.ndarray] = None
+    ) -> MultiTopicState:
+        nbrs, rev, nbr_valid = self.gs.build_graph(seed)
+        t, n, k, m, w = self.t, self.n, self.k, self.m, self.w
+        if subscribed is None:
+            subscribed = np.ones((t, n), bool)
+        subscribed = jnp.asarray(subscribed)
+        if subscribed.shape != (t, n):
+            raise ValueError(f"subscribed must be [T={t}, N={n}]")
+        zc = TopicCounters.zeros(n, k)
+        st = MultiTopicState(
+            nbrs=nbrs,
+            rev=rev,
+            nbr_valid=nbr_valid,
+            alive=jnp.ones((n,), bool),
+            subscribed=subscribed,
+            gcounters=GlobalCounters.zeros(n),
+            scores=jnp.zeros((n, k), jnp.float32),
+            mesh=jnp.zeros((t, n, k), bool),
+            backoff=jnp.zeros((t, n, k), jnp.int32),
+            counters=jax.tree.map(
+                lambda x: jnp.broadcast_to(x, (t, n, k)), zc
+            ),
+            have_w=jnp.zeros((t, n, w), jnp.uint32),
+            fresh_w=jnp.zeros((t, n, w), jnp.uint32),
+            gossip_pend_w=jnp.zeros((t, n, w), jnp.uint32),
+            first_step=jnp.full((t, n, m), -1, jnp.int32),
+            msg_valid=jnp.zeros((t, m), bool),
+            msg_birth=jnp.zeros((t, m), jnp.int32),
+            msg_active=jnp.zeros((t, m), bool),
+            msg_used=jnp.zeros((t, m), bool),
+            keys=jax.vmap(jax.random.fold_in, (None, 0))(
+                jax.random.PRNGKey(seed), jnp.arange(t)
+            ),
+            step=jnp.asarray(0, jnp.int32),
+        )
+        return self._warmup(st)
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def _warmup(self, st: MultiTopicState) -> MultiTopicState:
+        return self._heartbeat(self._heartbeat(self._heartbeat(st)))
+
+    # -- events -------------------------------------------------------------
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def publish(
+        self,
+        st: MultiTopicState,
+        topic: jax.Array,
+        src: jax.Array,
+        slot: jax.Array,
+        valid: jax.Array,
+    ) -> MultiTopicState:
+        """Seed a message at ``src`` in ``topic``'s window ``slot`` (the
+        shared ``seed_message`` recycle applied to the topic's slice)."""
+        from .gossipsub import seed_message
+
+        (have_t, fresh_t, pend_t, fs_t, mv, mb, ma, mu) = seed_message(
+            st.have_w[topic], st.fresh_w[topic], st.gossip_pend_w[topic],
+            st.first_step[topic], st.msg_valid[topic], st.msg_birth[topic],
+            st.msg_active[topic], st.msg_used[topic],
+            src, slot, valid, st.step, self.w,
+        )
+        return st._replace(
+            have_w=st.have_w.at[topic].set(have_t),
+            fresh_w=st.fresh_w.at[topic].set(fresh_t),
+            gossip_pend_w=st.gossip_pend_w.at[topic].set(pend_t),
+            first_step=st.first_step.at[topic].set(fs_t),
+            msg_valid=st.msg_valid.at[topic].set(mv),
+            msg_birth=st.msg_birth.at[topic].set(mb),
+            msg_active=st.msg_active.at[topic].set(ma),
+            msg_used=st.msg_used.at[topic].set(mu),
+        )
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def kill_peers(self, st: MultiTopicState, mask: jax.Array) -> MultiTopicState:
+        return st._replace(alive=st.alive & ~mask)
+
+    # -- transition ---------------------------------------------------------
+
+    def _topic_alive(self, st: MultiTopicState) -> jax.Array:
+        """bool[T, N]: a peer participates in a topic iff alive+subscribed."""
+        return st.alive[None, :] & st.subscribed
+
+    def _propagate(self, st: MultiTopicState) -> MultiTopicState:
+        """One eager-push round in every topic (vmapped single-topic round)."""
+        gs = self.gs
+
+        def one(mesh, backoff, counters, have_w, fresh_w, pend_w, first_step,
+                mv, mb, ma, mu, key, al):
+            g = GossipState(
+                nbrs=st.nbrs, rev=st.rev, nbr_valid=st.nbr_valid, alive=al,
+                mesh=mesh, backoff=backoff, counters=counters,
+                gcounters=st.gcounters, scores=st.scores, have_w=have_w,
+                fresh_w=fresh_w, gossip_pend_w=pend_w, first_step=first_step,
+                msg_valid=mv, msg_birth=mb, msg_active=ma, msg_used=mu,
+                key=key, step=st.step,
+            )
+            o = gs._propagate(g)
+            return (o.counters, o.have_w, o.fresh_w, o.gossip_pend_w,
+                    o.first_step)
+
+        counters, have_w, fresh_w, pend_w, first_step = jax.vmap(one)(
+            st.mesh, st.backoff, st.counters, st.have_w, st.fresh_w,
+            st.gossip_pend_w, st.first_step, st.msg_valid, st.msg_birth,
+            st.msg_active, st.msg_used, st.keys, self._topic_alive(st),
+        )
+        return st._replace(
+            counters=counters, have_w=have_w, fresh_w=fresh_w,
+            gossip_pend_w=pend_w, first_step=first_step,
+        )
+
+    def _heartbeat(self, st: MultiTopicState) -> MultiTopicState:
+        p, sp = self.params, self.score_params
+
+        # Tick + decay topic counters per topic; decay globals ONCE.
+        c = jax.vmap(
+            lambda ct, mesh_t: scoring_ops.decay_topic_counters(
+                scoring_ops.tick_mesh_clocks(
+                    ct, mesh_t, p.heartbeat_interval_s
+                ),
+                sp,
+            )
+        )(st.counters, st.mesh)
+        g = scoring_ops.decay_global_counters(st.gcounters, sp)
+
+        # v1.1 aggregation: sum of topic components over topics + globals.
+        tsc = jax.vmap(lambda ct: scoring_ops.topic_score(ct, sp))(c)
+        remote = scoring_ops.global_score(g, sp)[
+            jnp.clip(st.nbrs, 0, self.n - 1)
+        ]
+        scores = jnp.where(st.nbr_valid, tsc.sum(axis=0) + remote, -jnp.inf)
+
+        keys3 = jax.vmap(lambda k: jax.random.split(k, 3))(st.keys)
+        topic_alive = self._topic_alive(st)
+
+        def one(mesh_t, bo_t, c_t, have_t, pend_t, mv, ma, mbirth, k3, al):
+            khb, kgossip, knext = k3
+            new_mesh, grafted, pruned, bo2 = heartbeat_mesh(
+                khb, mesh_t, scores, st.nbrs, st.rev, st.nbr_valid, al, p,
+                bo_t,
+            )
+            c2 = scoring_ops.on_graft(
+                scoring_ops.on_prune(c_t, pruned, sp), grafted
+            )
+            pend = pend_t | gossip_ops.gossip_transfer_packed(
+                kgossip, have_t, new_mesh, st.nbrs, st.rev, st.nbr_valid,
+                al, scores, bitpack.pack(mv), p, sp.gossip_threshold,
+            )
+            expired = ma & (
+                st.step - mbirth > p.history_length * self.heartbeat_steps
+            )
+            return (
+                new_mesh, bo2, c2, pend & ~bitpack.pack(expired),
+                ma & ~expired, knext,
+            )
+
+        mesh, backoff, c, pend, mactive, keys = jax.vmap(one)(
+            st.mesh, st.backoff, c, st.have_w, st.gossip_pend_w, st.msg_valid,
+            st.msg_active, st.msg_birth, keys3, topic_alive,
+        )
+        return st._replace(
+            mesh=mesh, backoff=backoff, counters=c, gcounters=g,
+            scores=scores, gossip_pend_w=pend, msg_active=mactive, keys=keys,
+        )
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def step(self, st: MultiTopicState) -> MultiTopicState:
+        st = self._propagate(st)
+        st = jax.lax.cond(
+            (st.step % self.heartbeat_steps) == self.heartbeat_steps - 1,
+            self._heartbeat,
+            lambda s: s,
+            st,
+        )
+        return st._replace(step=st.step + 1)
+
+    @functools.partial(jax.jit, static_argnames=("self", "n_steps"))
+    def run(self, st: MultiTopicState, n_steps: int) -> MultiTopicState:
+        def body(s, _):
+            return self.step(s), None
+
+        st, _ = jax.lax.scan(body, st, None, length=n_steps)
+        return st
+
+    # -- views / metrics ----------------------------------------------------
+
+    def have_bool(self, st: MultiTopicState) -> jax.Array:
+        """bool[T, N, M] possession view."""
+        return bitpack.unpack(st.have_w, self.m)
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def delivery_stats(self, st: MultiTopicState):
+        """Per-topic (frac[T, M], p50[T], p99[T]) over subscribed+alive."""
+        topic_alive = self._topic_alive(st)           # [T, N]
+        have = self.have_bool(st)                     # [T, N, M]
+        alive_n = jnp.maximum(topic_alive.sum(axis=1), 1)   # [T]
+        delivered = (have & topic_alive[:, :, None]).sum(axis=1)  # [T, M]
+        frac = jnp.where(
+            st.msg_used & st.msg_valid,
+            delivered / alive_n[:, None],
+            jnp.nan,
+        )
+        lat = jnp.where(
+            st.first_step >= 0,
+            st.first_step - st.msg_birth[:, None, :],
+            -1,
+        )
+        ok = (
+            (lat >= 0)
+            & st.msg_used[:, None, :]
+            & st.msg_valid[:, None, :]
+            & topic_alive[:, :, None]
+        )
+        lat_f = jnp.where(ok, lat.astype(jnp.float32), jnp.nan)
+        flat = lat_f.reshape(self.t, -1)
+        p50 = jnp.nanmedian(flat, axis=1)
+        p99 = jnp.nanpercentile(flat, 99.0, axis=1)
+        return frac, p50, p99

@@ -12,15 +12,19 @@ are dense masks over those slots — every protocol rule becomes an elementwise
 op + a slot-axis reduction, which is exactly what the VPU wants.
 
 Simplifications vs the full v1.1 protocol, stated explicitly: no PX peer
-exchange, no prune-backoff window, no outbound-degree quota (D_out), and
-IHAVE/IWANT is modeled as one fused heartbeat-time transfer instead of two
-request/response round trips (the extra hop of latency is accounted by
-delivering gossip on the step after the heartbeat).
+exchange, no outbound-degree quota (D_out), and IHAVE/IWANT is modeled as
+one fused heartbeat-time transfer instead of two request/response round
+trips (the extra hop of latency is accounted by delivering gossip on the
+step after the heartbeat).  The spec's prune-backoff window IS implemented
+(``heartbeat_mesh``'s ``backoff`` state): a pruned edge cannot re-graft for
+``prune_backoff_heartbeats`` heartbeats — without it, a scored-out attacker
+re-enters the mesh as soon as its counters decay (see
+``tests/test_attacks.py``).
 """
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -142,24 +146,30 @@ def heartbeat_mesh(
     nbr_valid: jax.Array,
     alive: jax.Array,
     p: GossipSubParams,
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    backoff: Optional[jax.Array] = None,  # i32[N, K] heartbeats left
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Mesh maintenance: prune negative-score and over-degree links, graft
     toward D from well-scored candidates, then symmetrize edge state.
 
-    Returns (new_mesh, grafted, pruned) as bool[N, K].
+    Returns (new_mesh, grafted, pruned, new_backoff) as [N, K].
 
     Desired-set rules (each side computes independently, then edges agree):
     - drop slots whose score < 0 or whose remote died;
     - when degree > d_hi: keep the d_score best-scoring plus a random fill
       back to D (spec's oversubscription rule);
     - when degree < d_lo: graft random non-mesh candidates with score >= 0
-      up to D.
+      up to D, skipping slots inside their prune-backoff window.
     Edge agreement: an existing edge survives only if BOTH sides keep it; a
     new edge forms if EITHER side grafts and the other side's view of the
     requester is non-negative (GRAFT accepted) — the array form of
-    unilateral PRUNE / accepted GRAFT.
+    unilateral PRUNE / accepted GRAFT.  A pruned edge starts a
+    ``prune_backoff_heartbeats`` countdown on both endpoints' slots during
+    which it may not re-form (spec's PruneBackoff; GRAFTs inside backoff
+    are refused and would be penalized upstream).
     """
     n, k = nbrs.shape
+    if backoff is None:
+        backoff = jnp.zeros((n, k), jnp.int32)
     remote_alive = safe_gather(alive, nbrs, False)
     kmask = nbr_valid & remote_alive
 
@@ -189,10 +199,14 @@ def heartbeat_mesh(
     over = deg > p.d_hi
     keep = keep & jnp.where(over[:, None], best | fill, True)
 
-    # Grafting: random eligible non-mesh candidates up to D.
+    # Grafting: random eligible non-mesh candidates up to D, honoring the
+    # prune-backoff window on BOTH endpoints of the slot pair.
+    jidx0 = jnp.clip(nbrs, 0, n - 1)
+    ridx0 = jnp.clip(rev, 0, k - 1)
+    no_backoff = (backoff <= 0) & (backoff[jidx0, ridx0] <= 0)
     deg_now = keep.sum(axis=1)
     want_more = jnp.maximum(p.d - deg_now, 0)
-    cand = kmask & ~keep & (scores >= 0.0)
+    cand = kmask & ~keep & (scores >= 0.0) & no_backoff
     r = jax.random.uniform(kgraft, (n, k))
     r = jnp.where(cand, r, -1.0)
     corder = jnp.argsort(-r, axis=1)
@@ -225,4 +239,12 @@ def heartbeat_mesh(
 
     grafted = new_mesh & ~mesh
     pruned = mesh & ~new_mesh
-    return new_mesh, grafted, pruned
+    # Backoff bookkeeping: pruned edges (either side's view — the pairing is
+    # symmetric, so pruned[i,k] == pruned[j,rev]) restart the countdown;
+    # everything else ticks down one heartbeat.
+    new_backoff = jnp.where(
+        pruned,
+        jnp.int32(p.prune_backoff_heartbeats),
+        jnp.maximum(backoff - 1, 0),
+    )
+    return new_mesh, grafted, pruned, new_backoff

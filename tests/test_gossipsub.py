@@ -174,7 +174,7 @@ def test_oversubscription_keeps_dscore_best_plus_random_fill():
     alive = jnp.ones((n,), bool)
     picked = set()
     for seed in range(8):
-        new_mesh, _, _ = heartbeat_mesh(
+        new_mesh, _, _, _ = heartbeat_mesh(
             jax.random.PRNGKey(seed), mesh, scores, nbrs, rev, valid, alive, p
         )
         kept = np.flatnonzero(np.asarray(new_mesh[0]))

@@ -1,0 +1,115 @@
+"""Multi-topic GossipSub: isolation, subscription masking, cross-topic scoring."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu.config import ScoreParams
+from go_libp2p_pubsub_tpu.models.multitopic import MultiTopicGossipSub
+
+
+@pytest.fixture(scope="module")
+def mt():
+    return MultiTopicGossipSub(
+        n_topics=3, n_peers=96, n_slots=16, conn_degree=8, msg_window=32
+    )
+
+
+@pytest.fixture(scope="module")
+def st0(mt):
+    return mt.init(seed=2)
+
+
+def test_meshes_converge_independently(mt, st0):
+    mesh = np.asarray(st0.mesh)
+    deg = mesh.sum(axis=2)
+    assert (deg.max(axis=1) <= mt.params.d_hi).all()
+    assert deg.mean() >= mt.params.d_lo - 1
+    # Topics got different PRNG streams: meshes differ.
+    assert (mesh[0] != mesh[1]).any() and (mesh[1] != mesh[2]).any()
+
+
+def test_topic_isolation(mt, st0):
+    st = mt.publish(
+        st0, jnp.int32(1), jnp.int32(0), jnp.int32(0), jnp.asarray(True)
+    )
+    st = mt.run(st, 24)
+    frac, p50, _ = mt.delivery_stats(st)
+    frac = np.asarray(frac)
+    assert frac[1, 0] == 1.0, "published topic must fully deliver"
+    # Other topics saw nothing: no used message slots at all.
+    have = np.asarray(mt.have_bool(st))
+    assert not have[0].any() and not have[2].any()
+    assert float(p50[1]) > 0
+
+
+def test_subscription_masks_delivery(mt):
+    sub = np.ones((3, 96), bool)
+    sub[0, 48:] = False  # half the peers not subscribed to topic 0
+    st = mt.init(seed=4, subscribed=sub)
+    st = mt.publish(
+        st, jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.asarray(True)
+    )
+    st = mt.run(st, 24)
+    have = np.asarray(mt.have_bool(st))[0, :, 0]
+    assert have[:48].all(), "subscribed peers must all receive"
+    assert not have[48:].any(), "unsubscribed peers must never receive"
+    # And they are never grafted into topic 0's mesh.
+    mesh0 = np.asarray(st.mesh[0])
+    nbrs = np.asarray(st.nbrs)
+    to_unsub = mesh0 & (nbrs >= 48)
+    assert to_unsub[:48].sum() == 0
+
+
+def test_invalid_spam_in_one_topic_prunes_attacker_everywhere(mt):
+    """v1.1 aggregate scoring: P4 invalid-delivery penalties earned in topic
+    0 must push the attacker out of every topic's mesh."""
+    # Slow P4 decay so the penalty outlives the final settle window (fast
+    # decay legitimately re-admits a *reformed* attacker after full decay).
+    sp = ScoreParams(
+        invalid_message_deliveries_weight=-50.0,
+        invalid_message_deliveries_decay=0.9,
+    )
+    m = MultiTopicGossipSub(
+        n_topics=2, n_peers=64, n_slots=16, conn_degree=8, msg_window=32,
+        score_params=sp,
+    )
+    st = m.init(seed=7)
+    # Peer 0 spams invalid messages in topic 0 across several heartbeats.
+    for slot in range(12):
+        st = m.publish(
+            st, jnp.int32(0), jnp.int32(0), jnp.int32(slot), jnp.asarray(False)
+        )
+        st = m.run(st, 4)
+    st = m.run(st, 2 * m.heartbeat_steps)
+    mesh = np.asarray(st.mesh)
+    nbrs = np.asarray(st.nbrs)
+    slots_to_attacker = np.asarray(st.nbr_valid) & (nbrs == 0)
+    # Attacker evicted from BOTH topic meshes, including the clean topic 1.
+    assert (mesh[0] & slots_to_attacker).sum() == 0
+    assert (mesh[1] & slots_to_attacker).sum() == 0
+    # Honest peers still mesh with each other in topic 1.
+    assert (mesh[1].sum(axis=1) > 0).mean() > 0.9
+
+
+def test_multitopic_matches_singletopic_delivery():
+    """A 1-topic multitopic run delivers identically to the single-topic
+    model (same topology seed, full subscription)."""
+    from go_libp2p_pubsub_tpu.models.gossipsub import GossipSub
+
+    m = MultiTopicGossipSub(
+        n_topics=1, n_peers=96, n_slots=16, conn_degree=8, msg_window=32
+    )
+    g = GossipSub(
+        n_peers=96, n_slots=16, conn_degree=8, msg_window=32, use_pallas=False
+    )
+    sm = m.init(seed=3)
+    sg = g.init(seed=3)
+    sm = m.publish(sm, jnp.int32(0), jnp.int32(5), jnp.int32(0), jnp.asarray(True))
+    sg = g.publish(sg, jnp.int32(5), jnp.int32(0), jnp.asarray(True))
+    sm = m.run(sm, 24)
+    sg = g.run(sg, 24)
+    fm, p50m, _ = m.delivery_stats(sm)
+    fg, p50g, _ = g.delivery_stats(sg)
+    assert float(np.asarray(fm)[0, 0]) == 1.0
+    assert float(np.asarray(fg)[0]) == 1.0
