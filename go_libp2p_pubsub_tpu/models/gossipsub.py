@@ -47,6 +47,9 @@ class GossipState(NamedTuple):
     rev: jax.Array          # i32[N, K] remote's slot index back to me
     nbr_valid: jax.Array    # bool[N, K]
     alive: jax.Array        # bool[N]
+    edge_live: jax.Array    # bool[N, K] nbr_valid & alive[nbrs] — cached so
+                            # the per-step hot loops never re-gather liveness
+                            # (recomputed only at init / kill_peers)
     mesh: jax.Array         # bool[N, K] symmetric mesh membership
     backoff: jax.Array      # i32[N, K] prune-backoff heartbeats remaining
     counters: TopicCounters     # per-slot topic score counters
@@ -150,6 +153,21 @@ def build_topology_fast(
     return nbrs, rev, nbrs >= 0
 
 
+def compute_edge_live(
+    nbr_valid: jax.Array, nbrs: jax.Array, alive: jax.Array
+) -> jax.Array:
+    """bool[N, K]: slot is wired AND its remote peer is alive.
+
+    Liveness changes only at explicit events (init, kill_peers), so this
+    per-element gather runs per event, not per step — at 100k peers a single
+    [N, K] gather costs ~25 ms on a v5e chip, which the propagate and
+    heartbeat hot loops must not pay every round.
+    """
+    from ..ops.graphs import safe_gather
+
+    return nbr_valid & safe_gather(alive, nbrs, False)
+
+
 def seed_message(
     have_w, fresh_w, gossip_pend_w, first_step,
     msg_valid, msg_birth, msg_active, msg_used,
@@ -220,11 +238,13 @@ class GossipSub:
     def init(self, seed: int = 0) -> GossipState:
         nbrs, rev, valid = self.build_graph(seed)
         n, k, m, w = self.n, self.k, self.m, self.w
+        alive0 = jnp.ones((n,), bool)
         st = GossipState(
             nbrs=nbrs,
             rev=rev,
             nbr_valid=valid,
-            alive=jnp.ones((n,), bool),
+            alive=alive0,
+            edge_live=compute_edge_live(valid, nbrs, alive0),
             mesh=jnp.zeros((n, k), bool),
             backoff=jnp.zeros((n, k), jnp.int32),
             counters=TopicCounters.zeros(n, k),
@@ -286,7 +306,11 @@ class GossipSub:
     def kill_peers(self, st: GossipState, mask: jax.Array) -> GossipState:
         """Abrupt peer failure (liveness mask); the mesh self-heals at the
         next heartbeat — the fault-injection hook of the sim."""
-        return st._replace(alive=st.alive & ~mask)
+        alive = st.alive & ~mask
+        return st._replace(
+            alive=alive,
+            edge_live=compute_edge_live(st.nbr_valid, st.nbrs, alive),
+        )
 
     # -- transition ---------------------------------------------------------
 
@@ -301,7 +325,7 @@ class GossipSub:
         scores = scoring_ops.neighbor_scores(c, g, st.nbrs, st.nbr_valid, sp)
 
         new_mesh, grafted, pruned, backoff = heartbeat_mesh(
-            khb, st.mesh, scores, st.nbrs, st.rev, st.nbr_valid, st.alive, p,
+            khb, st.mesh, scores, st.nbrs, st.rev, st.edge_live, st.alive, p,
             st.backoff,
         )
         c = scoring_ops.on_prune(c, pruned, sp)
@@ -313,7 +337,7 @@ class GossipSub:
             new_mesh,
             st.nbrs,
             st.rev,
-            st.nbr_valid,
+            st.edge_live,
             st.alive,
             scores,
             bitpack.pack(st.msg_valid),
@@ -354,12 +378,12 @@ class GossipSub:
             from ..ops.pallas_gossip import propagate_packed_pallas
 
             out = propagate_packed_pallas(
-                st.mesh, st.nbrs, st.nbr_valid, st.alive, have_w, fresh_w,
+                st.mesh, st.nbrs, st.edge_live, st.alive, have_w, fresh_w,
                 valid_w, interpret=jax.default_backend() != "tpu",
             )
         else:
             out = gossip_ops.propagate_packed(
-                st.mesh, st.nbrs, st.nbr_valid, st.alive, have_w, fresh_w,
+                st.mesh, st.nbrs, st.edge_live, st.alive, have_w, fresh_w,
                 valid_w,
             )
         first_step = jnp.where(

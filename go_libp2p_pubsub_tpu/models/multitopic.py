@@ -38,7 +38,7 @@ from ..ops import gossip_packed as gossip_ops
 from ..ops import scoring as scoring_ops
 from ..ops.gossip import heartbeat_mesh
 from ..ops.scoring import GlobalCounters, TopicCounters
-from .gossipsub import GossipState, GossipSub
+from .gossipsub import GossipState, GossipSub, compute_edge_live
 
 
 class MultiTopicState(NamedTuple):
@@ -48,6 +48,8 @@ class MultiTopicState(NamedTuple):
     nbr_valid: jax.Array     # bool[N, K]
     alive: jax.Array         # bool[N]
     subscribed: jax.Array    # bool[T, N]
+    edge_live: jax.Array     # bool[T, N, K] valid & remote alive+subscribed,
+                             # cached per topic (recomputed at init/kill only)
     gcounters: GlobalCounters    # per-peer [N]
     scores: jax.Array        # f32[N, K] aggregate (cached at heartbeat)
     # per-topic (leading T)
@@ -111,12 +113,16 @@ class MultiTopicGossipSub:
         if subscribed.shape != (t, n):
             raise ValueError(f"subscribed must be [T={t}, N={n}]")
         zc = TopicCounters.zeros(n, k)
+        alive0 = jnp.ones((n,), bool)
         st = MultiTopicState(
             nbrs=nbrs,
             rev=rev,
             nbr_valid=nbr_valid,
-            alive=jnp.ones((n,), bool),
+            alive=alive0,
             subscribed=subscribed,
+            edge_live=jax.vmap(compute_edge_live, (None, None, 0))(
+                nbr_valid, nbrs, alive0[None, :] & subscribed
+            ),
             gcounters=GlobalCounters.zeros(n),
             scores=jnp.zeros((n, k), jnp.float32),
             mesh=jnp.zeros((t, n, k), bool),
@@ -177,7 +183,13 @@ class MultiTopicGossipSub:
 
     @functools.partial(jax.jit, static_argnums=0)
     def kill_peers(self, st: MultiTopicState, mask: jax.Array) -> MultiTopicState:
-        return st._replace(alive=st.alive & ~mask)
+        alive = st.alive & ~mask
+        return st._replace(
+            alive=alive,
+            edge_live=jax.vmap(compute_edge_live, (None, None, 0))(
+                st.nbr_valid, st.nbrs, alive[None, :] & st.subscribed
+            ),
+        )
 
     # -- transition ---------------------------------------------------------
 
@@ -190,10 +202,10 @@ class MultiTopicGossipSub:
         gs = self.gs
 
         def one(mesh, backoff, counters, have_w, fresh_w, pend_w, first_step,
-                mv, mb, ma, mu, key, al):
+                mv, mb, ma, mu, key, al, el):
             g = GossipState(
                 nbrs=st.nbrs, rev=st.rev, nbr_valid=st.nbr_valid, alive=al,
-                mesh=mesh, backoff=backoff, counters=counters,
+                edge_live=el, mesh=mesh, backoff=backoff, counters=counters,
                 gcounters=st.gcounters, scores=st.scores, have_w=have_w,
                 fresh_w=fresh_w, gossip_pend_w=pend_w, first_step=first_step,
                 msg_valid=mv, msg_birth=mb, msg_active=ma, msg_used=mu,
@@ -207,6 +219,7 @@ class MultiTopicGossipSub:
             st.mesh, st.backoff, st.counters, st.have_w, st.fresh_w,
             st.gossip_pend_w, st.first_step, st.msg_valid, st.msg_birth,
             st.msg_active, st.msg_used, st.keys, self._topic_alive(st),
+            st.edge_live,
         )
         return st._replace(
             counters=counters, have_w=have_w, fresh_w=fresh_w,
@@ -237,17 +250,16 @@ class MultiTopicGossipSub:
         keys3 = jax.vmap(lambda k: jax.random.split(k, 3))(st.keys)
         topic_alive = self._topic_alive(st)
 
-        def one(mesh_t, bo_t, c_t, have_t, pend_t, mv, ma, mbirth, k3, al):
+        def one(mesh_t, bo_t, c_t, have_t, pend_t, mv, ma, mbirth, k3, al, el):
             khb, kgossip, knext = k3
             new_mesh, grafted, pruned, bo2 = heartbeat_mesh(
-                khb, mesh_t, scores, st.nbrs, st.rev, st.nbr_valid, al, p,
-                bo_t,
+                khb, mesh_t, scores, st.nbrs, st.rev, el, al, p, bo_t,
             )
             c2 = scoring_ops.on_graft(
                 scoring_ops.on_prune(c_t, pruned, sp), grafted
             )
             pend = pend_t | gossip_ops.gossip_transfer_packed(
-                kgossip, have_t, new_mesh, st.nbrs, st.rev, st.nbr_valid,
+                kgossip, have_t, new_mesh, st.nbrs, st.rev, el,
                 al, scores, bitpack.pack(mv), p, sp.gossip_threshold,
             )
             expired = ma & (
@@ -260,7 +272,7 @@ class MultiTopicGossipSub:
 
         mesh, backoff, c, pend, mactive, keys = jax.vmap(one)(
             st.mesh, st.backoff, c, st.have_w, st.gossip_pend_w, st.msg_valid,
-            st.msg_active, st.msg_birth, keys3, topic_alive,
+            st.msg_active, st.msg_birth, keys3, topic_alive, st.edge_live,
         )
         return st._replace(
             mesh=mesh, backoff=backoff, counters=c, gcounters=g,

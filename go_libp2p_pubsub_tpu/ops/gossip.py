@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from ..config import GossipSubParams
-from .graphs import safe_gather
+from .graphs import safe_gather, top_mask
 
 
 class PropagateOut(NamedTuple):
@@ -95,7 +95,7 @@ def gossip_transfer(
     have: jax.Array,        # bool[N, M]
     mesh: jax.Array,        # bool[N, K]
     nbrs: jax.Array,
-    nbr_valid: jax.Array,
+    edge_live: jax.Array,   # bool[N, K] valid slot AND remote alive (cached)
     alive: jax.Array,
     scores: jax.Array,      # f32[N, K] my view of each neighbor slot
     msg_valid: jax.Array,   # bool[M]
@@ -116,16 +116,11 @@ def gossip_transfer(
     if d_lazy <= 0:  # gossip disabled (a negative index would wrap: pick all)
         return jnp.zeros_like(have)
     eligible = (
-        nbr_valid
-        & ~mesh
-        & safe_gather(alive, nbrs, False)
-        & (scores >= gossip_threshold)
+        edge_live & ~mesh & alive[:, None] & (scores >= gossip_threshold)
     )
     # Random top-d_lazy among eligible slots.
     r = jax.random.uniform(key, (n, k))
-    r = jnp.where(eligible, r, -1.0)
-    thresh = -jnp.sort(-r, axis=1)[:, d_lazy - 1][:, None]
-    chosen = eligible & (r >= thresh) & (r > 0)
+    chosen = top_mask(jnp.where(eligible, r, -jnp.inf), d_lazy)
 
     # Scatter-or into targets: pend[t, m] |= have[i, m] & ~have[t, m].
     t = jnp.where(chosen, nbrs, n).reshape(-1)                    # i32[N*K]
@@ -143,7 +138,7 @@ def heartbeat_mesh(
     scores: jax.Array,     # f32[N, K]
     nbrs: jax.Array,
     rev: jax.Array,
-    nbr_valid: jax.Array,
+    edge_live: jax.Array,  # bool[N, K] valid slot AND remote alive (cached)
     alive: jax.Array,
     p: GossipSubParams,
     backoff: Optional[jax.Array] = None,  # i32[N, K] heartbeats left
@@ -170,8 +165,10 @@ def heartbeat_mesh(
     n, k = nbrs.shape
     if backoff is None:
         backoff = jnp.zeros((n, k), jnp.int32)
-    remote_alive = safe_gather(alive, nbrs, False)
-    kmask = nbr_valid & remote_alive
+    # Own-liveness folded in makes kmask SYMMETRIC across the slot pairing
+    # (valid & alive[i] & alive[j]), so the agreement rules below produce a
+    # symmetric mesh by construction — no enforcement gather needed.
+    kmask = edge_live & alive[:, None]
 
     keep = mesh & kmask & (scores >= 0.0)
     deg = keep.sum(axis=1)
@@ -184,58 +181,54 @@ def heartbeat_mesh(
     # inflates P1/P2 deterministically occupy every retained slot — the
     # eclipse vector the random fill exists to break).
     noise = jax.random.uniform(kkeep, (n, k), minval=0.0, maxval=1e-3)
-    rank_key = jnp.where(keep, scores + noise, -jnp.inf)
-    order = jnp.argsort(-rank_key, axis=1)                        # best first
-    pos = jnp.zeros((n, k), jnp.int32).at[
-        jnp.arange(n)[:, None], order
-    ].set(jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32), (n, k)))
-    best = keep & (pos < p.d_score)
-    rfill = jnp.where(keep & ~best, noise, -jnp.inf)              # random order
-    rorder = jnp.argsort(-rfill, axis=1)
-    rpos = jnp.zeros((n, k), jnp.int32).at[
-        jnp.arange(n)[:, None], rorder
-    ].set(jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32), (n, k)))
-    fill = keep & ~best & (rpos < max(p.d - p.d_score, 0))
+    best = top_mask(jnp.where(keep, scores + noise, -jnp.inf), p.d_score)
+    fill = top_mask(
+        jnp.where(keep & ~best, noise, -jnp.inf), max(p.d - p.d_score, 0)
+    )
     over = deg > p.d_hi
     keep = keep & jnp.where(over[:, None], best | fill, True)
 
-    # Grafting: random eligible non-mesh candidates up to D, honoring the
-    # prune-backoff window on BOTH endpoints of the slot pair.
-    jidx0 = jnp.clip(nbrs, 0, n - 1)
-    ridx0 = jnp.clip(rev, 0, k - 1)
-    no_backoff = (backoff <= 0) & (backoff[jidx0, ridx0] <= 0)
+    # Grafting: random eligible non-mesh candidates up to D.  My own backoff
+    # gates candidacy; the REMOTE's backoff vetoes acceptance below (the
+    # wire analog: a GRAFT inside the peer's backoff window is refused).
     deg_now = keep.sum(axis=1)
-    want_more = jnp.maximum(p.d - deg_now, 0)
-    cand = kmask & ~keep & (scores >= 0.0) & no_backoff
+    want_more = jnp.maximum(p.d - deg_now, 0).astype(jnp.int32)
+    score_ok = scores >= 0.0
+    bo_ok = backoff <= 0
+    cand = kmask & ~keep & score_ok & bo_ok
     r = jax.random.uniform(kgraft, (n, k))
-    r = jnp.where(cand, r, -1.0)
-    corder = jnp.argsort(-r, axis=1)
-    cpos = jnp.zeros((n, k), jnp.int32).at[
-        jnp.arange(n)[:, None], corder
-    ].set(jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32), (n, k)))
-    graft = cand & (cpos < want_more[:, None]) & (r > 0)
+    graft = top_mask(jnp.where(cand, r, -jnp.inf), want_more, kmax=p.d)
 
     # Edge agreement via the reverse index.  For my slot (i, k) pointing at
     # j = nbrs[i, k], the remote's matching slot is (j, rev[i, k]); indexing
-    # any [N, K] per-slot array at [jidx, ridx] reads the remote's view of
-    # this same edge.
+    # a per-slot array at [jidx, ridx] reads the remote's view of this same
+    # edge.  Per-element gathers are latency-bound on TPU (~tens of ms at
+    # 100k peers), so the four remote views ride ONE int32 bitfield gather.
     jidx = jnp.clip(nbrs, 0, n - 1)
     ridx = jnp.clip(rev, 0, k - 1)
-    keep_rev = keep[jidx, ridx]
-    graft_rev = graft[jidx, ridx]
-    remote_score_of_me = scores[jidx, ridx]
+    flags = (
+        keep.astype(jnp.int32)
+        | (graft.astype(jnp.int32) << 1)
+        | (score_ok.astype(jnp.int32) << 2)
+        | (bo_ok.astype(jnp.int32) << 3)
+    )
+    flags_rev = flags[jidx, ridx]
+    keep_rev = (flags_rev & 1) > 0
+    graft_rev = (flags_rev & 2) > 0
+    score_rev_ok = (flags_rev & 4) > 0
+    bo_rev_ok = (flags_rev & 8) > 0
 
     # Existing edge survives only if BOTH sides keep it (unilateral PRUNE).
     survives = mesh & keep & keep_rev
-    # New edge forms if either side grafts and the other accepts (its score
-    # of the requester is non-negative) — accepted GRAFT semantics.
+    # New edge forms if either side grafts and the other accepts: its score
+    # of the requester is non-negative and it is outside its backoff window
+    # (accepted GRAFT semantics).
     forms = ~mesh & (
-        (graft & (remote_score_of_me >= 0.0)) | (graft_rev & (scores >= 0.0))
+        (graft & score_rev_ok & bo_rev_ok) | (graft_rev & score_ok & bo_ok)
     )
+    # kmask is symmetric and survives/forms are mirrored expressions, so
+    # new_mesh[i,k] == new_mesh[j,rev] holds by construction.
     new_mesh = kmask & (survives | forms)
-    # The rules above are symmetric by construction; enforce exactly anyway
-    # so counter updates can trust mesh[i,k] == mesh[j,rev].
-    new_mesh = new_mesh & new_mesh[jidx, ridx]
 
     grafted = new_mesh & ~mesh
     pruned = mesh & ~new_mesh

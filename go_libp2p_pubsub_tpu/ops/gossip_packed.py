@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from ..config import GossipSubParams
-from .graphs import safe_gather
+from .graphs import top_mask
 
 FULL = jnp.uint32(0xFFFFFFFF)
 
@@ -71,7 +71,7 @@ class PropagatePackedOut(NamedTuple):
 def propagate_packed(
     mesh: jax.Array,       # bool[N, K]
     nbrs: jax.Array,       # i32[N, K]
-    nbr_valid: jax.Array,  # bool[N, K]
+    edge_live: jax.Array,  # bool[N, K] valid slot AND remote alive (cached)
     alive: jax.Array,      # bool[N]
     have_w: jax.Array,     # u32[N, W]
     fresh_w: jax.Array,    # u32[N, W]
@@ -86,7 +86,7 @@ def propagate_packed(
     n = nbrs.shape[0]
 
     j = jnp.clip(nbrs, 0, n - 1)
-    edge_ok = mesh & nbr_valid & safe_gather(alive, nbrs, False)   # bool[N, K]
+    edge_ok = mesh & edge_live                                     # bool[N, K]
     inc = _as_mask(edge_ok)[:, :, None] & fresh_w[j]               # u32[N, K, W]
 
     before = exclusive_or_scan(inc, axis=1)
@@ -119,7 +119,7 @@ def gossip_transfer_packed(
     mesh: jax.Array,       # bool[N, K]
     nbrs: jax.Array,       # i32[N, K]
     rev: jax.Array,        # i32[N, K]
-    nbr_valid: jax.Array,  # bool[N, K]
+    edge_live: jax.Array,  # bool[N, K] valid slot AND remote alive (cached)
     alive: jax.Array,      # bool[N]
     scores: jax.Array,     # f32[N, K]
     valid_w: jax.Array,    # u32[W]
@@ -138,20 +138,15 @@ def gossip_transfer_packed(
     if d_lazy <= 0:
         return jnp.zeros_like(have_w)
     eligible = (
-        nbr_valid
-        & ~mesh
-        & safe_gather(alive, nbrs, False)
-        & (scores >= gossip_threshold)
+        edge_live & ~mesh & alive[:, None] & (scores >= gossip_threshold)
     )
     r = jax.random.uniform(key, (n, k))
-    r = jnp.where(eligible, r, -1.0)
-    thresh = -jnp.sort(-r, axis=1)[:, d_lazy - 1][:, None]
-    chosen = eligible & (r >= thresh) & (r > 0)
+    chosen = top_mask(jnp.where(eligible, r, -jnp.inf), d_lazy)
 
     # Target side: neighbor j = nbrs[t, s] chose me iff chosen[j, rev[t, s]].
     jidx = jnp.clip(nbrs, 0, n - 1)
     ridx = jnp.clip(rev, 0, k - 1)
-    towards_me = chosen[jidx, ridx] & nbr_valid                    # bool[N, K]
+    towards_me = chosen[jidx, ridx] & edge_live                    # bool[N, K]
     offered = _as_mask(towards_me)[:, :, None] & have_w[jidx]      # u32[N, K, W]
     offered = jax.lax.reduce(
         offered, jnp.uint32(0), jax.lax.bitwise_or, dimensions=(1,)

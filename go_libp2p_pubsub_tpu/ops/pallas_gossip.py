@@ -41,7 +41,6 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .gossip_packed import PropagatePackedOut, _as_mask
-from .graphs import safe_gather
 
 TILE = 512
 
@@ -106,7 +105,7 @@ def _propagate_kernel(
 def propagate_packed_pallas(
     mesh: jax.Array,       # bool[N, K]
     nbrs: jax.Array,       # i32[N, K]
-    nbr_valid: jax.Array,  # bool[N, K]
+    edge_live: jax.Array,  # bool[N, K] valid slot AND remote alive (cached)
     alive: jax.Array,      # bool[N]
     have_w: jax.Array,     # u32[N, W]
     fresh_w: jax.Array,    # u32[N, W]
@@ -121,7 +120,7 @@ def propagate_packed_pallas(
     l = k * w
 
     j = jnp.clip(nbrs, 0, n - 1)
-    edge_ok = mesh & nbr_valid & safe_gather(alive, nbrs, False)
+    edge_ok = mesh & edge_live
     # Gather + edge masking in one XLA fusion; [N, K, W] -> [N, K*W] is a
     # layout-preserving reshape of the gather output.
     inc = jnp.where(edge_ok[:, :, None], fresh_w[j], jnp.uint32(0)).reshape(n, l)

@@ -75,11 +75,12 @@ def test_propagate_packed_matches_reference(seed):
     first_step = jnp.full((n, m), -1, jnp.int32)
     step = jnp.int32(7)
 
+    edge_live = valid & np.asarray(alive)[np.clip(np.asarray(nbrs), 0, len(alive) - 1)]
     ref = ref_ops.propagate(
         mesh, nbrs, valid, alive, have, fresh, first_step, msg_valid, step
     )
     out = packed_ops.propagate_packed(
-        mesh, nbrs, valid, alive,
+        mesh, nbrs, jnp.asarray(edge_live), alive,
         bitpack.pack(have), bitpack.pack(fresh), bitpack.pack(msg_valid),
     )
 
@@ -110,11 +111,15 @@ def test_gossip_transfer_packed_matches_reference(seed):
     p = GossipSubParams(d_lazy=4)
     key = jax.random.PRNGKey(seed)
 
+    edge_live = jnp.asarray(
+        np.asarray(valid)
+        & np.asarray(alive)[np.clip(np.asarray(nbrs), 0, len(alive) - 1)]
+    )
     ref = ref_ops.gossip_transfer(
-        key, have, mesh, nbrs, valid, alive, scores, msg_valid, p, -0.5
+        key, have, mesh, nbrs, edge_live, alive, scores, msg_valid, p, -0.5
     )
     out = packed_ops.gossip_transfer_packed(
-        key, bitpack.pack(have), mesh, nbrs, rev, valid, alive, scores,
+        key, bitpack.pack(have), mesh, nbrs, rev, edge_live, alive, scores,
         bitpack.pack(msg_valid), p, -0.5,
     )
     np.testing.assert_array_equal(
@@ -128,7 +133,7 @@ def test_gossip_transfer_packed_disabled_when_d_lazy_zero():
         jax.random.PRNGKey(0), bitpack.pack(have), mesh, nbrs, rev, valid,
         alive, jnp.zeros_like(nbrs, jnp.float32), bitpack.pack(msg_valid),
         GossipSubParams(d_lazy=0), -10.0,
-    )
+    )  # edge_live == valid here: liveness of remotes is irrelevant at d_lazy=0
     assert not bool(np.asarray(out).any())
 
 

@@ -145,7 +145,7 @@ def test_gossip_disabled_when_d_lazy_zero():
     st = gs.init(seed=0)
     have = jnp.zeros((32, 8), bool).at[0, 0].set(True)
     pend = gossip_transfer(
-        jax.random.PRNGKey(0), have, st.mesh, st.nbrs, st.nbr_valid,
+        jax.random.PRNGKey(0), have, st.mesh, st.nbrs, st.edge_live,
         st.alive, st.scores, jnp.ones((8,), bool),
         GossipSubParams(d_lazy=0), -10.0,
     )
@@ -176,7 +176,7 @@ def test_oversubscription_keeps_dscore_best_plus_random_fill():
     for seed in range(8):
         new_mesh, _, _, _ = heartbeat_mesh(
             jax.random.PRNGKey(seed), mesh, scores, nbrs, rev, valid, alive, p
-        )
+        )  # all peers alive: edge_live == valid
         kept = np.flatnonzero(np.asarray(new_mesh[0]))
         assert len(kept) <= p.d
         # The two best-scoring slots (k-1, k-2) always survive.

@@ -24,10 +24,11 @@ def _state(seed, n, k=32, m=128, degree=12):
     have = rng.random((n, m)) < 0.2
     fresh = have & (rng.random((n, m)) < 0.5)
     msg_valid = rng.random(m) < 0.8
+    edge_live = valid & alive[np.clip(nbrs, 0, n - 1)]
     return (
         jnp.asarray(mesh),
         jnp.asarray(nbrs, jnp.int32),
-        jnp.asarray(valid),
+        jnp.asarray(edge_live),
         jnp.asarray(alive),
         bitpack.pack(jnp.asarray(have)),
         bitpack.pack(jnp.asarray(fresh)),
