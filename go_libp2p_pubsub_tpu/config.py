@@ -119,15 +119,28 @@ class GossipSubParams:
     fanout_ttl_s: float = 60.0
     gossip_factor: float = 0.25
     opportunistic_graft_peers: int = 2
+    opportunistic_graft_ticks: int = 8  # heartbeats between opportunistic checks
     max_ihave_length: int = 5000
     seen_ttl_s: float = 120.0
     prune_backoff_heartbeats: int = 4  # spec's PruneBackoff, in heartbeats
+    flood_publish: bool = True  # own publishes go to ALL topic peers above
+    #                             publish_threshold (go-gossipsub default)
 
     def __post_init__(self) -> None:
         if not (self.d_lo <= self.d <= self.d_hi):
             raise ValueError("require d_lo <= d <= d_hi")
         if self.history_gossip > self.history_length:
             raise ValueError("history_gossip must be <= history_length")
+        if self.d_out > self.d_lo or 2 * self.d_out > self.d:
+            # The spec's constraint: the outbound quota must be satisfiable
+            # under both the graft floor and the oversubscription keep rule.
+            raise ValueError("require d_out <= d_lo and d_out <= d/2")
+        if self.prune_backoff_heartbeats < 0:
+            # 0 is a documented off switch; negatives would silently disable
+            # the window via the `backoff <= 0` re-graft test (ADVICE r1).
+            raise ValueError("prune_backoff_heartbeats must be >= 0")
+        if self.opportunistic_graft_ticks < 1:
+            raise ValueError("opportunistic_graft_ticks must be >= 1")
 
 
 @dataclass(frozen=True)

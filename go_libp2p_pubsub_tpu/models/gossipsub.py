@@ -32,6 +32,8 @@ from ..ops import bitpack
 from ..ops import gossip_packed as gossip_ops
 from ..ops import scoring as scoring_ops
 from ..ops.gossip import heartbeat_mesh
+from ..ops.graphs import safe_gather, top_mask
+from ..ops.px import px_rewire
 from ..ops.scoring import GlobalCounters, TopicCounters
 
 
@@ -46,11 +48,18 @@ class GossipState(NamedTuple):
     nbrs: jax.Array         # i32[N, K] connection slots -> remote peer id
     rev: jax.Array          # i32[N, K] remote's slot index back to me
     nbr_valid: jax.Array    # bool[N, K]
+    outbound: jax.Array     # bool[N, K] I dialed this edge (v1.1 d_out quota)
     alive: jax.Array        # bool[N]
+    subscribed: jax.Array   # bool[N] topic membership (mesh/relay eligibility)
     edge_live: jax.Array    # bool[N, K] nbr_valid & alive[nbrs] — cached so
                             # the per-step hot loops never re-gather liveness
-                            # (recomputed only at init / kill_peers)
+                            # (recomputed only at init / kill_peers / PX)
+    nbr_sub: jax.Array      # bool[N, K] cached subscribed[nbrs] (recomputed
+                            # at subscription events / PX only)
     mesh: jax.Array         # bool[N, K] symmetric mesh membership
+    fanout: jax.Array       # bool[N, K] fanout peers of a non-subscribed
+                            # publisher (spec's fanout map; see publish)
+    fanout_age: jax.Array   # i32[N] heartbeats since last fanout publish
     backoff: jax.Array      # i32[N, K] prune-backoff heartbeats remaining
     counters: TopicCounters     # per-slot topic score counters
     gcounters: GlobalCounters   # per-peer global score inputs
@@ -58,6 +67,8 @@ class GossipState(NamedTuple):
     have_w: jax.Array       # u32[N, W] possession (seen-cache within window)
     fresh_w: jax.Array      # u32[N, W] first-received last round
     gossip_pend_w: jax.Array  # u32[N, W] IWANT deliveries due next round
+    adv_w: jax.Array        # u32[N, K, W] IHAVEs received at the last
+                            # heartbeat, awaiting the IWANT round
     first_step: jax.Array   # i32[N, M] first-receipt step, -1 = never
     msg_valid: jax.Array    # bool[M] validation verdict
     msg_birth: jax.Array    # i32[M] publish step
@@ -69,17 +80,20 @@ class GossipState(NamedTuple):
 
 def build_topology(
     rng: np.random.Generator, n: int, k: int, degree: int
-) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Random ~degree-regular undirected graph in neighbor-slot form.
 
     Host-side one-time setup (the analog of the test fixtures' full-mesh
     ``connectUp``, ``pubsub_test.go:37-57``, but sparse).  Returns
-    (nbrs, rev, nbr_valid).
+    (nbrs, rev, nbr_valid, outbound); ``outbound[i, s]`` marks the dialing
+    side of each edge (the first element of the pairing dials) — the v1.1
+    ``d_out`` quota's notion of a connection I opened myself.
     """
     if degree >= k:
         raise ValueError(f"degree ({degree}) must be < slot count k ({k})")
     nbrs = np.full((n, k), -1, np.int64)
     rev = np.full((n, k), -1, np.int64)
+    outbound = np.zeros((n, k), bool)
     used = np.zeros(n, np.int64)
     adj = [set() for _ in range(n)]
     # Union of `degree` random perfect-matching-ish pairings.
@@ -92,35 +106,41 @@ def build_topology(
             si, sj = used[i], used[j]
             nbrs[i, si], nbrs[j, sj] = j, i
             rev[i, si], rev[j, sj] = sj, si
+            outbound[i, si] = True  # i dialed j
             adj[i].add(j)
             adj[j].add(i)
             used[i] += 1
             used[j] += 1
-    return nbrs, rev, nbrs >= 0
+    return nbrs, rev, nbrs >= 0, outbound
 
 
 def build_topology_fast(
     rng: np.random.Generator, n: int, k: int, degree: int
-) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Vectorized topology builder for large N (100k peers in ~100 ms where
     the per-edge Python loop of ``build_topology`` takes minutes).
 
     Same construction idea — union of ``degree`` random pairings — but each
     pairing is admitted with NumPy set-ops instead of per-edge Python.
     Duplicate edges across rounds are dropped (slightly lower mean degree,
-    same as the loop version's skip rule).
+    same as the loop version's skip rule).  Returns
+    (nbrs, rev, nbr_valid, outbound); the dialing side of each edge is drawn
+    uniformly at random.
     """
     if degree >= k:
         raise ValueError(f"degree ({degree}) must be < slot count k ({k})")
     if degree == 0:
         empty = np.full((n, k), -1, np.int64)
-        return empty, empty.copy(), empty >= 0
+        return empty, empty.copy(), empty >= 0, np.zeros((n, k), bool)
     pairs = []
     for _ in range(degree):
         perm = rng.permutation(n).astype(np.int64)
         a, b = perm[0 : n - 1 : 2], perm[1:n:2]
         pairs.append(np.stack([np.minimum(a, b), np.maximum(a, b)], 1))
     e = np.unique(np.concatenate(pairs, 0), axis=0)  # dedup undirected edges
+    dialer = np.where(
+        rng.integers(0, 2, len(e)).astype(bool), e[:, 0], e[:, 1]
+    )
     # Per-endpoint slot indices via cumulative counts; drop edges overflowing k.
     src = np.concatenate([e[:, 0], e[:, 1]])
     dst = np.concatenate([e[:, 1], e[:, 0]])
@@ -142,7 +162,9 @@ def build_topology_fast(
     slot_s = np.arange(len(src_s)) - starts[src_s]
     nbrs = np.full((n, k), -1, np.int64)
     rev = np.full((n, k), -1, np.int64)
+    outbound = np.zeros((n, k), bool)
     nbrs[src_s, slot_s] = dst_s
+    outbound[src_s, slot_s] = dialer[eid] == src_s
     # rev: my slot back-pointer = the slot my counterpart assigned this edge.
     # Sort by (eid, src): the two directions of each edge become adjacent
     # pairs, and each direction's rev is its pair partner's slot.
@@ -150,7 +172,7 @@ def build_topology_fast(
     rev_sorted = np.empty(len(src_s), np.int64)
     rev_sorted[o2] = slot_s[o2].reshape(-1, 2)[:, ::-1].reshape(-1)
     rev[src_s, slot_s] = rev_sorted
-    return nbrs, rev, nbrs >= 0
+    return nbrs, rev, nbrs >= 0, outbound
 
 
 def compute_edge_live(
@@ -204,6 +226,7 @@ class GossipSub:
         score_params: Optional[ScoreParams] = None,
         heartbeat_steps: int = 8,
         use_pallas: Optional[bool] = None,
+        builder=None,
     ):
         self.n = n_peers
         self.k = n_slots
@@ -213,6 +236,7 @@ class GossipSub:
         self.params = params or GossipSubParams()
         self.score_params = score_params or ScoreParams()
         self.heartbeat_steps = heartbeat_steps
+        self.builder = builder  # explicit topology builder (seed pinning)
         # Pallas fast path: unsharded TPU arrays only.  The jnp ops partition
         # under GSPMD for the peer-sharded sim (see parallel/), while a
         # pallas_call would need shard_map — sharded runners must pass
@@ -224,28 +248,51 @@ class GossipSub:
         self.use_pallas = use_pallas
 
     def build_graph(self, seed: int = 0):
-        """Connection topology only -> (nbrs, rev, nbr_valid) as jnp arrays
-        (the loop builder is exact for small N; the vectorized one scales)."""
+        """Connection topology only -> (nbrs, rev, nbr_valid, outbound) as
+        jnp arrays.
+
+        The loop builder is exact for small N; the vectorized one scales —
+        crossing the 4096-peer threshold changes which builder (and which
+        rng draw order) generates the topology, so the same seed yields a
+        DIFFERENT graph on each side of it (documented seed-compatibility
+        break; pass ``builder=`` to pin one explicitly).
+        """
         rng = np.random.default_rng(seed)
-        builder = build_topology if self.n <= 4096 else build_topology_fast
-        nbrs, rev, valid = builder(rng, self.n, self.k, self.conn_degree)
+        builder = self.builder or (
+            build_topology if self.n <= 4096 else build_topology_fast
+        )
+        nbrs, rev, valid, outbound = builder(rng, self.n, self.k, self.conn_degree)
         return (
             jnp.asarray(nbrs, jnp.int32),
             jnp.asarray(rev, jnp.int32),
             jnp.asarray(valid),
+            jnp.asarray(outbound),
         )
 
-    def init(self, seed: int = 0) -> GossipState:
-        nbrs, rev, valid = self.build_graph(seed)
+    def init(
+        self, seed: int = 0, subscribed: Optional[np.ndarray] = None
+    ) -> GossipState:
+        """Fresh state; ``subscribed`` masks topic membership (default: all
+        peers subscribed — non-members neither mesh nor relay, and publish
+        via fanout/flood)."""
+        nbrs, rev, valid, outbound = self.build_graph(seed)
         n, k, m, w = self.n, self.k, self.m, self.w
         alive0 = jnp.ones((n,), bool)
+        sub0 = (
+            jnp.ones((n,), bool) if subscribed is None else jnp.asarray(subscribed)
+        )
         st = GossipState(
             nbrs=nbrs,
             rev=rev,
             nbr_valid=valid,
+            outbound=outbound,
             alive=alive0,
+            subscribed=sub0,
             edge_live=compute_edge_live(valid, nbrs, alive0),
+            nbr_sub=valid & safe_gather(sub0, nbrs, False),
             mesh=jnp.zeros((n, k), bool),
+            fanout=jnp.zeros((n, k), bool),
+            fanout_age=jnp.full((n,), jnp.iinfo(jnp.int32).max // 2, jnp.int32),
             backoff=jnp.zeros((n, k), jnp.int32),
             counters=TopicCounters.zeros(n, k),
             gcounters=GlobalCounters.zeros(n),
@@ -253,6 +300,7 @@ class GossipSub:
             have_w=jnp.zeros((n, w), jnp.uint32),
             fresh_w=jnp.zeros((n, w), jnp.uint32),
             gossip_pend_w=jnp.zeros((n, w), jnp.uint32),
+            adv_w=jnp.zeros((n, k, w), jnp.uint32),
             first_step=jnp.full((n, m), -1, jnp.int32),
             msg_valid=jnp.zeros((m,), bool),
             msg_birth=jnp.zeros((m,), jnp.int32),
@@ -289,17 +337,71 @@ class GossipSub:
         ``valid=False`` publishes a message that will fail validation at
         every receiver — the attack-trace injection point (the reference's
         missing signature hole, ``pubsub.go:117``, made explicit).
+
+        First-hop fan-out (spec rules, both reading ``publish_threshold``):
+
+        - ``flood_publish=True``: the message is offered to ALL connected
+          topic peers scoring at least ``publish_threshold`` (landing next
+          round via the pend fold), alongside normal mesh relay;
+        - ``flood_publish=False`` and ``src`` not subscribed: the publisher
+          maintains a ``fanout`` set of up to D above-threshold topic peers
+          (refreshed here and aged out by ``fanout_ttl_s`` at heartbeats)
+          and offers to those — a non-member publisher has no mesh, so
+          fanout is its only first hop.
+
+        Flood/fanout copies carry no per-slot attribution, so they earn no
+        P2/P3 delivery credit (and invalid messages never flood: they exist
+        only on the eager path where P4 blame can land on a slot).
         """
+        p, sp = self.params, self.score_params
+        n, k = self.n, self.k
         (have_w, fresh_w, pend_w, first_step,
          mv, mb, ma, mu) = seed_message(
             st.have_w, st.fresh_w, st.gossip_pend_w, st.first_step,
             st.msg_valid, st.msg_birth, st.msg_active, st.msg_used,
             src, slot, valid, st.step, self.w,
         )
+        kpub, knext = jax.random.split(st.key)
+        scores_src = st.scores[src]                              # f32[K]
+        eligible = (
+            st.edge_live[src]
+            & st.nbr_sub[src]
+            & (scores_src >= sp.publish_threshold)
+        )
+        fanout, fanout_age = st.fanout, st.fanout_age
+        if p.flood_publish:
+            targets = eligible
+        else:
+            # Fanout top-up to D for a non-subscribed publisher.
+            cur = st.fanout[src] & eligible
+            want = jnp.clip(p.d - cur.sum(), 0, p.d).astype(jnp.int32)
+            r = jax.random.uniform(kpub, (1, k))
+            add = top_mask(
+                jnp.where((eligible & ~cur)[None, :], r, -jnp.inf),
+                want[None],
+                kmax=p.d,
+            )[0]
+            newf = cur | add
+            is_sub = st.subscribed[src]
+            targets = jnp.where(is_sub, jnp.zeros((k,), bool), newf)
+            fanout = st.fanout.at[src].set(
+                jnp.where(is_sub, st.fanout[src], newf)
+            )
+            fanout_age = st.fanout_age.at[src].set(
+                jnp.where(is_sub, st.fanout_age[src], 0)
+            )
+        # Offered copies land next round through the pend fold (one hop of
+        # latency, like any send).  Valid-only: see docstring.
+        bm = bitpack.bit_mask(slot, self.w)                      # u32[W]
+        rows = jnp.where(targets, st.nbrs[src], n)
+        gathered = pend_w[jnp.clip(rows, 0, n - 1)]              # u32[K, W]
+        upd = gathered | jnp.where(valid, bm, jnp.uint32(0))[None, :]
+        pend_w = pend_w.at[rows].set(upd, mode="drop")
         return st._replace(
             have_w=have_w, fresh_w=fresh_w, gossip_pend_w=pend_w,
             first_step=first_step, msg_valid=mv, msg_birth=mb,
-            msg_active=ma, msg_used=mu,
+            msg_active=ma, msg_used=mu, fanout=fanout,
+            fanout_age=fanout_age, key=knext,
         )
 
     @functools.partial(jax.jit, static_argnums=0)
@@ -312,11 +414,28 @@ class GossipSub:
             edge_live=compute_edge_live(st.nbr_valid, st.nbrs, alive),
         )
 
+    @functools.partial(jax.jit, static_argnums=0)
+    def set_subscribed(self, st: GossipState, sub: jax.Array) -> GossipState:
+        """Change topic membership (bool[N]).
+
+        Unsubscribing prunes the peer's mesh edges immediately (the wire
+        sends PRUNE on unsubscribe); subscribing drops any fanout state (the
+        spec moves fanout peers into the mesh on join — here the next
+        heartbeat grafts from scratch, which converges the same way).
+        """
+        nbr_sub = st.nbr_valid & safe_gather(sub, st.nbrs, False)
+        return st._replace(
+            subscribed=sub,
+            nbr_sub=nbr_sub,
+            mesh=st.mesh & sub[:, None] & nbr_sub,
+            fanout=st.fanout & ~sub[:, None],
+        )
+
     # -- transition ---------------------------------------------------------
 
     def _heartbeat(self, st: GossipState) -> GossipState:
         p, sp = self.params, self.score_params
-        khb, kgossip, knext = jax.random.split(st.key, 3)
+        khb, kgossip, kfan, kpx, knext = jax.random.split(st.key, 5)
 
         # Advance mesh clocks by one heartbeat interval; decay; re-score.
         c = scoring_ops.tick_mesh_clocks(st.counters, st.mesh, p.heartbeat_interval_s)
@@ -324,44 +443,117 @@ class GossipSub:
         g = scoring_ops.decay_global_counters(st.gcounters, sp)
         scores = scoring_ops.neighbor_scores(c, g, st.nbrs, st.nbr_valid, sp)
 
+        # Topic participation: mesh forms only between alive+subscribed
+        # endpoints (the model folds subscription into the liveness view the
+        # kernels already symmetrize over).
+        part = st.alive & st.subscribed
+        edge_ok = st.edge_live & st.nbr_sub
+        hb_idx = st.step // self.heartbeat_steps
+        do_og = (hb_idx % p.opportunistic_graft_ticks) == 0
+
         new_mesh, grafted, pruned, backoff = heartbeat_mesh(
-            khb, st.mesh, scores, st.nbrs, st.rev, st.edge_live, st.alive, p,
-            st.backoff,
+            khb, st.mesh, scores, st.nbrs, st.rev, edge_ok, part, p,
+            st.backoff, st.outbound, do_og,
         )
         c = scoring_ops.on_prune(c, pruned, sp)
         c = scoring_ops.on_graft(c, grafted)
 
-        gossip_pend_w = st.gossip_pend_w | gossip_ops.gossip_transfer_packed(
-            kgossip,
-            st.have_w,
-            new_mesh,
-            st.nbrs,
-            st.rev,
-            st.edge_live,
-            st.alive,
-            scores,
-            bitpack.pack(st.msg_valid),
-            p,
+        # Peer exchange on prune (v1.1 PX): pruned peers may open one new
+        # connection toward a mesh neighbor of their pruner, gated by
+        # accept_px_threshold.  The adjacency caches are regathered only
+        # when a PX edge actually formed (rare; lax.cond skips the gathers
+        # otherwise).
+        px = px_rewire(
+            kpx, st.nbrs, st.rev, st.nbr_valid, st.outbound, backoff,
+            new_mesh, pruned, scores, st.alive, sp.accept_px_threshold,
+        )
+        edge_live, nbr_sub = jax.lax.cond(
+            px.connected.any(),
+            lambda: (
+                compute_edge_live(px.nbr_valid, px.nbrs, st.alive),
+                px.nbr_valid & safe_gather(st.subscribed, px.nbrs, False),
+            ),
+            lambda: (st.edge_live, st.nbr_sub),
+        )
+
+        # IHAVE phase of the two-round gossip exchange: advertisements are
+        # recorded per receiving slot; the IWANT and transfer happen on the
+        # next two propagate rounds.  Advertisable window = valid, in-mcache,
+        # and within the last history_gossip heartbeats (the spec's gossip
+        # window is narrower than the retention window).
+        gossip_age_ok = (
+            st.step - st.msg_birth <= p.history_gossip * self.heartbeat_steps
+        )
+        gossip_w = bitpack.pack(st.msg_valid & st.msg_active & gossip_age_ok)
+        adv_w = gossip_ops.ihave_advertise_packed(
+            kgossip, st.have_w, new_mesh, px.nbrs, px.rev,
+            edge_live & nbr_sub, part, scores, gossip_w, p,
             sp.gossip_threshold,
         )
+
+        # Fanout maintenance for non-subscribed publishers: age out after
+        # fanout_ttl_s of publish silence; drop dead/below-threshold peers;
+        # top back up to D while active.
+        fanout_ttl_hb = max(
+            1, round(p.fanout_ttl_s / p.heartbeat_interval_s)
+        )
+        age = jnp.minimum(
+            st.fanout_age + 1, jnp.iinfo(jnp.int32).max // 2
+        )
+        factive = (age <= fanout_ttl_hb) & ~st.subscribed & st.alive
+        feligible = edge_live & nbr_sub & (scores >= sp.publish_threshold)
+        fkeep = st.fanout & feligible
+        fwant = jnp.where(
+            factive, jnp.clip(p.d - fkeep.sum(axis=1), 0, p.d), 0
+        ).astype(jnp.int32)
+        fadd = top_mask(
+            jnp.where(
+                feligible & ~fkeep,
+                jax.random.uniform(kfan, (self.n, self.k)),
+                -jnp.inf,
+            ),
+            fwant,
+            kmax=p.d,
+        )
+        fanout = jnp.where(factive[:, None], fkeep | fadd, False)
+
+        # Seen-cache TTL: receipts older than seen_ttl_s fall out of the
+        # dedup window (first_step keeps the delivery record for metrics).
+        seen_ttl_steps = (
+            max(1, round(p.seen_ttl_s / p.heartbeat_interval_s))
+            * self.heartbeat_steps
+        )
+        seen_expired = st.msg_used & (st.step - st.msg_birth > seen_ttl_steps)
 
         # Expire messages out of the mcache history window.
         expired = st.msg_active & (
             st.step - st.msg_birth > p.history_length * self.heartbeat_steps
         )
+        dead_w = bitpack.pack(expired)
         return st._replace(
+            nbrs=px.nbrs,
+            rev=px.rev,
+            nbr_valid=px.nbr_valid,
+            outbound=px.outbound,
+            edge_live=edge_live,
+            nbr_sub=nbr_sub,
             mesh=new_mesh,
-            backoff=backoff,
+            fanout=fanout,
+            fanout_age=age,
+            backoff=px.backoff,
             counters=c,
             gcounters=g,
             scores=scores,
-            gossip_pend_w=gossip_pend_w & ~bitpack.pack(expired),
+            have_w=st.have_w & ~bitpack.pack(seen_expired),
+            gossip_pend_w=st.gossip_pend_w & ~dead_w[None, :],
+            adv_w=adv_w & ~dead_w[None, None, :],
             msg_active=st.msg_active & ~expired,
             key=knext,
         )
 
     def _propagate(self, st: GossipState) -> GossipState:
-        # Fold due gossip deliveries into this round's receipts.
+        # Fold due gossip/flood deliveries (requested or offered last round)
+        # into this round's receipts.
         gossip_new = (
             st.gossip_pend_w & ~st.have_w & gossip_ops._as_mask(st.alive)[:, None]
         )
@@ -373,17 +565,30 @@ class GossipSub:
             st.first_step,
         )
 
+        # IWANT phase: turn last heartbeat's IHAVE snapshot into pull
+        # requests for what we still lack; the transfer lands next round via
+        # the fold above (two wire hops after the IHAVE, as on the wire).
+        pend_next = gossip_ops.iwant_requests_packed(
+            st.adv_w, have_w, st.edge_live, st.alive
+        )
+
+        # Eager push over the mesh, graylist-gated receiver-side: frames
+        # from neighbors scored below graylist_threshold are ignored
+        # entirely (ScoreParams.graylist_threshold, the spec's RPC gate).
+        relay_mesh = st.mesh & (
+            st.scores >= self.score_params.graylist_threshold
+        )
         valid_w = bitpack.pack(st.msg_valid & st.msg_active)
         if self.use_pallas:
             from ..ops.pallas_gossip import propagate_packed_pallas
 
             out = propagate_packed_pallas(
-                st.mesh, st.nbrs, st.edge_live, st.alive, have_w, fresh_w,
+                relay_mesh, st.nbrs, st.edge_live, st.alive, have_w, fresh_w,
                 valid_w, interpret=jax.default_backend() != "tpu",
             )
         else:
             out = gossip_ops.propagate_packed(
-                st.mesh, st.nbrs, st.edge_live, st.alive, have_w, fresh_w,
+                relay_mesh, st.nbrs, st.edge_live, st.alive, have_w, fresh_w,
                 valid_w,
             )
         first_step = jnp.where(
@@ -404,7 +609,8 @@ class GossipSub:
             fresh_w=out.fresh_w,
             first_step=first_step,
             counters=c,
-            gossip_pend_w=jnp.zeros_like(st.gossip_pend_w),
+            gossip_pend_w=pend_next,
+            adv_w=jnp.zeros_like(st.adv_w),
         )
 
     @functools.partial(jax.jit, static_argnums=0)
@@ -435,20 +641,27 @@ class GossipSub:
         """Per-message delivery fraction and latency percentiles (in rounds).
 
         The headline metrics of BASELINE.json: delivery parity + p50
-        propagation latency.
+        propagation latency.  Delivery is counted from ``first_step`` (the
+        immutable receipt record) over alive+subscribed peers, so the
+        seen-cache TTL clearing ``have_w`` bits never un-counts a delivery.
         """
-        alive_n = st.alive.sum()
-        have = self.have_bool(st)
-        delivered = (have & st.alive[:, None]).sum(axis=0)  # i32[M]
+        part = st.alive & st.subscribed
+        part_n = part.sum()
+        delivered = ((st.first_step >= 0) & part[:, None]).sum(axis=0)  # i32[M]
         frac = jnp.where(
             st.msg_used & st.msg_valid,
-            delivered / jnp.maximum(alive_n, 1),
+            delivered / jnp.maximum(part_n, 1),
             jnp.nan,
         )
         lat = jnp.where(
             st.first_step >= 0, st.first_step - st.msg_birth[None, :], -1
         )
-        valid_lat = (lat >= 0) & st.msg_used[None, :] & st.msg_valid[None, :]
+        valid_lat = (
+            (lat >= 0)
+            & st.msg_used[None, :]
+            & st.msg_valid[None, :]
+            & part[:, None]
+        )
         lat_f = jnp.where(valid_lat, lat.astype(jnp.float32), jnp.nan)
         p50 = jnp.nanmedian(lat_f)
         p99 = jnp.nanpercentile(lat_f, 99.0)

@@ -46,6 +46,8 @@ class MultiTopicState(NamedTuple):
     nbrs: jax.Array          # i32[N, K]
     rev: jax.Array           # i32[N, K]
     nbr_valid: jax.Array     # bool[N, K]
+    outbound: jax.Array      # bool[N, K] dialed-by-me (shared: connections,
+                             # not meshes, have a direction)
     alive: jax.Array         # bool[N]
     subscribed: jax.Array    # bool[T, N]
     edge_live: jax.Array     # bool[T, N, K] valid & remote alive+subscribed,
@@ -54,11 +56,14 @@ class MultiTopicState(NamedTuple):
     scores: jax.Array        # f32[N, K] aggregate (cached at heartbeat)
     # per-topic (leading T)
     mesh: jax.Array          # bool[T, N, K]
+    fanout: jax.Array        # bool[T, N, K] non-subscribed publishers' fanout
+    fanout_age: jax.Array    # i32[T, N]
     backoff: jax.Array       # i32[T, N, K] prune-backoff (per topic, per spec)
     counters: TopicCounters  # f32[T, N, K] leaves
     have_w: jax.Array        # u32[T, N, W]
     fresh_w: jax.Array       # u32[T, N, W]
     gossip_pend_w: jax.Array # u32[T, N, W]
+    adv_w: jax.Array         # u32[T, N, K, W] IHAVEs awaiting IWANT
     first_step: jax.Array    # i32[T, N, M]
     msg_valid: jax.Array     # bool[T, M]
     msg_birth: jax.Array     # i32[T, M]
@@ -105,7 +110,7 @@ class MultiTopicGossipSub:
     def init(
         self, seed: int = 0, subscribed: Optional[np.ndarray] = None
     ) -> MultiTopicState:
-        nbrs, rev, nbr_valid = self.gs.build_graph(seed)
+        nbrs, rev, nbr_valid, outbound = self.gs.build_graph(seed)
         t, n, k, m, w = self.t, self.n, self.k, self.m, self.w
         if subscribed is None:
             subscribed = np.ones((t, n), bool)
@@ -118,6 +123,7 @@ class MultiTopicGossipSub:
             nbrs=nbrs,
             rev=rev,
             nbr_valid=nbr_valid,
+            outbound=outbound,
             alive=alive0,
             subscribed=subscribed,
             edge_live=jax.vmap(compute_edge_live, (None, None, 0))(
@@ -126,6 +132,10 @@ class MultiTopicGossipSub:
             gcounters=GlobalCounters.zeros(n),
             scores=jnp.zeros((n, k), jnp.float32),
             mesh=jnp.zeros((t, n, k), bool),
+            fanout=jnp.zeros((t, n, k), bool),
+            fanout_age=jnp.full(
+                (t, n), jnp.iinfo(jnp.int32).max // 2, jnp.int32
+            ),
             backoff=jnp.zeros((t, n, k), jnp.int32),
             counters=jax.tree.map(
                 lambda x: jnp.broadcast_to(x, (t, n, k)), zc
@@ -133,6 +143,7 @@ class MultiTopicGossipSub:
             have_w=jnp.zeros((t, n, w), jnp.uint32),
             fresh_w=jnp.zeros((t, n, w), jnp.uint32),
             gossip_pend_w=jnp.zeros((t, n, w), jnp.uint32),
+            adv_w=jnp.zeros((t, n, k, w), jnp.uint32),
             first_step=jnp.full((t, n, m), -1, jnp.int32),
             msg_valid=jnp.zeros((t, m), bool),
             msg_birth=jnp.zeros((t, m), jnp.int32),
@@ -161,15 +172,54 @@ class MultiTopicGossipSub:
         valid: jax.Array,
     ) -> MultiTopicState:
         """Seed a message at ``src`` in ``topic``'s window ``slot`` (the
-        shared ``seed_message`` recycle applied to the topic's slice)."""
+        shared ``seed_message`` recycle applied to the topic's slice), with
+        the same first-hop rules as the single-topic model: flood-publish to
+        above-``publish_threshold`` topic peers, or fanout for a
+        non-subscribed publisher when flooding is off."""
+        from ..ops.graphs import top_mask
         from .gossipsub import seed_message
 
+        p, sp = self.params, self.score_params
+        n, k = self.n, self.k
         (have_t, fresh_t, pend_t, fs_t, mv, mb, ma, mu) = seed_message(
             st.have_w[topic], st.fresh_w[topic], st.gossip_pend_w[topic],
             st.first_step[topic], st.msg_valid[topic], st.msg_birth[topic],
             st.msg_active[topic], st.msg_used[topic],
             src, slot, valid, st.step, self.w,
         )
+        kpub = jax.random.fold_in(st.keys[topic], st.step)
+        eligible = st.edge_live[topic][src] & (
+            st.scores[src] >= sp.publish_threshold
+        )
+        fanout, fanout_age = st.fanout, st.fanout_age
+        if p.flood_publish:
+            targets = eligible
+        else:
+            cur = st.fanout[topic, src] & eligible
+            want = jnp.clip(p.d - cur.sum(), 0, p.d).astype(jnp.int32)
+            add = top_mask(
+                jnp.where(
+                    (eligible & ~cur)[None, :],
+                    jax.random.uniform(kpub, (1, k)),
+                    -jnp.inf,
+                ),
+                want[None],
+                kmax=p.d,
+            )[0]
+            newf = cur | add
+            is_sub = st.subscribed[topic, src]
+            targets = jnp.where(is_sub, jnp.zeros((k,), bool), newf)
+            fanout = st.fanout.at[topic, src].set(
+                jnp.where(is_sub, st.fanout[topic, src], newf)
+            )
+            fanout_age = st.fanout_age.at[topic, src].set(
+                jnp.where(is_sub, st.fanout_age[topic, src], 0)
+            )
+        bm = bitpack.bit_mask(slot, self.w)
+        rows = jnp.where(targets, st.nbrs[src], n)
+        gathered = pend_t[jnp.clip(rows, 0, n - 1)]
+        upd = gathered | jnp.where(valid, bm, jnp.uint32(0))[None, :]
+        pend_t = pend_t.at[rows].set(upd, mode="drop")
         return st._replace(
             have_w=st.have_w.at[topic].set(have_t),
             fresh_w=st.fresh_w.at[topic].set(fresh_t),
@@ -179,6 +229,8 @@ class MultiTopicGossipSub:
             msg_birth=st.msg_birth.at[topic].set(mb),
             msg_active=st.msg_active.at[topic].set(ma),
             msg_used=st.msg_used.at[topic].set(mu),
+            fanout=fanout,
+            fanout_age=fanout_age,
         )
 
     @functools.partial(jax.jit, static_argnums=0)
@@ -198,32 +250,39 @@ class MultiTopicGossipSub:
         return st.alive[None, :] & st.subscribed
 
     def _propagate(self, st: MultiTopicState) -> MultiTopicState:
-        """One eager-push round in every topic (vmapped single-topic round)."""
+        """One eager-push + IWANT round in every topic (vmapped single-topic
+        round; the per-topic ``GossipState`` is assembled from the stacked
+        slices, with shared leaves broadcast)."""
         gs = self.gs
+        ones_nk = jnp.ones((self.n, self.k), bool)
+        inactive_age = jnp.full((self.n,), jnp.iinfo(jnp.int32).max // 2,
+                                jnp.int32)
 
-        def one(mesh, backoff, counters, have_w, fresh_w, pend_w, first_step,
-                mv, mb, ma, mu, key, al, el):
+        def one(mesh, fanout, backoff, counters, have_w, fresh_w, pend_w,
+                adv_w, first_step, mv, mb, ma, mu, key, al, el, sub):
             g = GossipState(
-                nbrs=st.nbrs, rev=st.rev, nbr_valid=st.nbr_valid, alive=al,
-                edge_live=el, mesh=mesh, backoff=backoff, counters=counters,
+                nbrs=st.nbrs, rev=st.rev, nbr_valid=st.nbr_valid,
+                outbound=st.outbound, alive=al, subscribed=sub,
+                edge_live=el, nbr_sub=ones_nk, mesh=mesh, fanout=fanout,
+                fanout_age=inactive_age, backoff=backoff, counters=counters,
                 gcounters=st.gcounters, scores=st.scores, have_w=have_w,
-                fresh_w=fresh_w, gossip_pend_w=pend_w, first_step=first_step,
-                msg_valid=mv, msg_birth=mb, msg_active=ma, msg_used=mu,
-                key=key, step=st.step,
+                fresh_w=fresh_w, gossip_pend_w=pend_w, adv_w=adv_w,
+                first_step=first_step, msg_valid=mv, msg_birth=mb,
+                msg_active=ma, msg_used=mu, key=key, step=st.step,
             )
             o = gs._propagate(g)
             return (o.counters, o.have_w, o.fresh_w, o.gossip_pend_w,
-                    o.first_step)
+                    o.adv_w, o.first_step)
 
-        counters, have_w, fresh_w, pend_w, first_step = jax.vmap(one)(
-            st.mesh, st.backoff, st.counters, st.have_w, st.fresh_w,
-            st.gossip_pend_w, st.first_step, st.msg_valid, st.msg_birth,
-            st.msg_active, st.msg_used, st.keys, self._topic_alive(st),
-            st.edge_live,
+        counters, have_w, fresh_w, pend_w, adv_w, first_step = jax.vmap(one)(
+            st.mesh, st.fanout, st.backoff, st.counters, st.have_w,
+            st.fresh_w, st.gossip_pend_w, st.adv_w, st.first_step,
+            st.msg_valid, st.msg_birth, st.msg_active, st.msg_used, st.keys,
+            self._topic_alive(st), st.edge_live, st.subscribed,
         )
         return st._replace(
             counters=counters, have_w=have_w, fresh_w=fresh_w,
-            gossip_pend_w=pend_w, first_step=first_step,
+            gossip_pend_w=pend_w, adv_w=adv_w, first_step=first_step,
         )
 
     def _heartbeat(self, st: MultiTopicState) -> MultiTopicState:
@@ -247,36 +306,79 @@ class MultiTopicGossipSub:
         ]
         scores = jnp.where(st.nbr_valid, tsc.sum(axis=0) + remote, -jnp.inf)
 
-        keys3 = jax.vmap(lambda k: jax.random.split(k, 3))(st.keys)
+        keys4 = jax.vmap(lambda k: jax.random.split(k, 4))(st.keys)
         topic_alive = self._topic_alive(st)
+        hb_idx = st.step // self.heartbeat_steps
+        do_og = (hb_idx % p.opportunistic_graft_ticks) == 0
+        fanout_ttl_hb = max(1, round(p.fanout_ttl_s / p.heartbeat_interval_s))
+        seen_ttl_steps = (
+            max(1, round(p.seen_ttl_s / p.heartbeat_interval_s))
+            * self.heartbeat_steps
+        )
 
-        def one(mesh_t, bo_t, c_t, have_t, pend_t, mv, ma, mbirth, k3, al, el):
-            khb, kgossip, knext = k3
+        def one(mesh_t, fan_t, fage_t, bo_t, c_t, have_t, pend_t, mv, ma,
+                mbirth, mused, k4, al, el, sub_t):
+            khb, kgossip, kfan, knext = k4
             new_mesh, grafted, pruned, bo2 = heartbeat_mesh(
                 khb, mesh_t, scores, st.nbrs, st.rev, el, al, p, bo_t,
+                st.outbound, do_og,
             )
             c2 = scoring_ops.on_graft(
                 scoring_ops.on_prune(c_t, pruned, sp), grafted
             )
-            pend = pend_t | gossip_ops.gossip_transfer_packed(
-                kgossip, have_t, new_mesh, st.nbrs, st.rev, el,
-                al, scores, bitpack.pack(mv), p, sp.gossip_threshold,
+            # PX is not run per topic: it rewires the SHARED connection
+            # layer, and T topics racing scatter-writes into one adjacency
+            # would break the slot pairing.  (Single-topic model runs it.)
+            gossip_age_ok = (
+                st.step - mbirth <= p.history_gossip * self.heartbeat_steps
             )
+            adv = gossip_ops.ihave_advertise_packed(
+                kgossip, have_t, new_mesh, st.nbrs, st.rev, el, al, scores,
+                bitpack.pack(mv & ma & gossip_age_ok), p, sp.gossip_threshold,
+            )
+            # Fanout upkeep for this topic's non-subscribed publishers.
+            fage2 = jnp.minimum(fage_t + 1, jnp.iinfo(jnp.int32).max // 2)
+            factive = (fage2 <= fanout_ttl_hb) & ~sub_t & st.alive
+            feligible = el & (scores >= sp.publish_threshold)
+            fkeep = fan_t & feligible
+            fwant = jnp.where(
+                factive, jnp.clip(p.d - fkeep.sum(axis=1), 0, p.d), 0
+            ).astype(jnp.int32)
+            from ..ops.graphs import top_mask as _top_mask
+            fadd = _top_mask(
+                jnp.where(
+                    feligible & ~fkeep,
+                    jax.random.uniform(kfan, (self.n, self.k)),
+                    -jnp.inf,
+                ),
+                fwant,
+                kmax=p.d,
+            )
+            fan2 = jnp.where(factive[:, None], fkeep | fadd, False)
+
+            seen_expired = mused & (st.step - mbirth > seen_ttl_steps)
             expired = ma & (
                 st.step - mbirth > p.history_length * self.heartbeat_steps
             )
+            dead_w = bitpack.pack(expired)
             return (
-                new_mesh, bo2, c2, pend & ~bitpack.pack(expired),
+                new_mesh, fan2, fage2, bo2, c2,
+                have_t & ~bitpack.pack(seen_expired),
+                pend_t & ~dead_w[None, :],
+                adv & ~dead_w[None, None, :],
                 ma & ~expired, knext,
             )
 
-        mesh, backoff, c, pend, mactive, keys = jax.vmap(one)(
-            st.mesh, st.backoff, c, st.have_w, st.gossip_pend_w, st.msg_valid,
-            st.msg_active, st.msg_birth, keys3, topic_alive, st.edge_live,
+        (mesh, fanout, fanout_age, backoff, c, have_w, pend, adv_w, mactive,
+         keys) = jax.vmap(one)(
+            st.mesh, st.fanout, st.fanout_age, st.backoff, c, st.have_w,
+            st.gossip_pend_w, st.msg_valid, st.msg_active, st.msg_birth,
+            st.msg_used, keys4, topic_alive, st.edge_live, st.subscribed,
         )
         return st._replace(
-            mesh=mesh, backoff=backoff, counters=c, gcounters=g,
-            scores=scores, gossip_pend_w=pend, msg_active=mactive, keys=keys,
+            mesh=mesh, fanout=fanout, fanout_age=fanout_age, backoff=backoff,
+            counters=c, gcounters=g, scores=scores, have_w=have_w,
+            gossip_pend_w=pend, adv_w=adv_w, msg_active=mactive, keys=keys,
         )
 
     @functools.partial(jax.jit, static_argnums=0)

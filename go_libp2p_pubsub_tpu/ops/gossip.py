@@ -11,15 +11,24 @@ without searches).  Mesh membership, score counters, and message possession
 are dense masks over those slots — every protocol rule becomes an elementwise
 op + a slot-axis reduction, which is exactly what the VPU wants.
 
-Simplifications vs the full v1.1 protocol, stated explicitly: no PX peer
-exchange, no outbound-degree quota (D_out), and IHAVE/IWANT is modeled as
-one fused heartbeat-time transfer instead of two request/response round
-trips (the extra hop of latency is accounted by delivering gossip on the
-step after the heartbeat).  The spec's prune-backoff window IS implemented
-(``heartbeat_mesh``'s ``backoff`` state): a pruned edge cannot re-graft for
-``prune_backoff_heartbeats`` heartbeats — without it, a scored-out attacker
-re-enters the mesh as soon as its counters decay (see
-``tests/test_attacks.py``).
+v1.1 mechanisms implemented here (each read from ``GossipSubParams``):
+
+- prune-backoff window (``heartbeat_mesh``'s ``backoff`` state): a pruned
+  edge cannot re-graft for ``prune_backoff_heartbeats`` heartbeats;
+- outbound-degree quota ``d_out``: the oversubscription keep-rule retains at
+  least ``d_out`` dialed-by-me edges, and under-quota peers graft outbound
+  candidates even at full degree (the spec's eclipse defense: a victim whose
+  mesh is all inbound attacker connections keeps some self-chosen links);
+- opportunistic grafting: every ``opportunistic_graft_ticks`` heartbeats, a
+  peer whose median mesh score sits below ``opportunistic_graft_threshold``
+  grafts ``opportunistic_graft_peers`` candidates scoring above that median
+  (breaks slow-eclipse meshes that keep scores just above zero);
+- two-phase IHAVE/IWANT: ``ihave_advertise`` emits heartbeat advertisements
+  (an adjacency-slot-indexed window snapshot) honoring ``history_gossip``,
+  ``gossip_factor`` and ``max_ihave_length``; the IWANT request + delivery
+  happen on the following rounds in the model's propagate (one extra hop of
+  latency vs the eager path, as on the wire).  Peer exchange on prune (PX)
+  lives in ``ops/px.py``.
 """
 
 from __future__ import annotations
@@ -61,6 +70,11 @@ def propagate(
     The [N, K, M] incoming tensor is the fused "who sent me what" cube; XLA
     keeps it in registers/VMEM per tile.  Invalid messages are dropped at
     validation and NOT relayed (their P4 blame lands on the delivering slot).
+
+    Graylisting (``ScoreParams.graylist_threshold``) is receiver-side edge
+    masking and composes by the caller passing ``mesh & (scores >=
+    graylist_threshold)`` — a graylisted sender's frames are ignored exactly
+    as the spec ignores RPCs from below-graylist peers.
     """
     n, k = nbrs.shape
 
@@ -90,46 +104,99 @@ def propagate(
     )
 
 
-def gossip_transfer(
+def gossip_emission_mask(
+    key: jax.Array,
+    mesh: jax.Array,        # bool[N, K]
+    edge_live: jax.Array,   # bool[N, K] valid slot AND remote alive (cached)
+    alive: jax.Array,       # bool[N]
+    scores: jax.Array,      # f32[N, K]
+    p: GossipSubParams,
+    gossip_threshold: float,
+) -> jax.Array:
+    """bool[N, K]: the neighbor slots each peer advertises to this heartbeat.
+
+    Eligibility: live non-mesh edges whose score clears ``gossip_threshold``.
+    Emission degree is the spec's ``max(d_lazy, gossip_factor * n_eligible)``
+    — the adaptive-gossip rule that keeps coverage as the eligible set grows.
+    """
+    n, k = mesh.shape
+    eligible = edge_live & ~mesh & alive[:, None] & (scores >= gossip_threshold)
+    d_lazy = min(p.d_lazy, k)
+    if d_lazy <= 0:  # gossip disabled
+        return jnp.zeros((n, k), bool)
+    n_eligible = eligible.sum(axis=1).astype(jnp.float32)
+    emit = jnp.maximum(
+        jnp.int32(d_lazy), jnp.ceil(p.gossip_factor * n_eligible).astype(jnp.int32)
+    )
+    r = jax.random.uniform(key, (n, k))
+    return top_mask(jnp.where(eligible, r, -jnp.inf), emit, kmax=k)
+
+
+def cap_ihave(adv: jax.Array, max_len: int) -> jax.Array:
+    """Truncate each IHAVE (bool[..., M] advertisement) to at most ``max_len``
+    message ids, at 32-bit-word granularity.
+
+    The packed kernels can only count set bits per uint32 word, so the cap
+    keeps whole words while the cumulative id count fits — always <= the
+    spec's ``max_ihave_length`` (under-advertising is compliant; the packed
+    and unpacked forms stay bit-identical).
+    """
+    m = adv.shape[-1]
+    w = (m + 31) // 32
+    padded = jnp.pad(adv, [(0, 0)] * (adv.ndim - 1) + [(0, w * 32 - m)])
+    words = padded.reshape(adv.shape[:-1] + (w, 32))
+    counts = words.sum(axis=-1)
+    cum = jnp.cumsum(counts, axis=-1)
+    keep = (cum <= max_len)[..., None]
+    return (words & keep).reshape(adv.shape[:-1] + (w * 32,))[..., :m]
+
+
+def ihave_advertise(
     key: jax.Array,
     have: jax.Array,        # bool[N, M]
     mesh: jax.Array,        # bool[N, K]
     nbrs: jax.Array,
+    rev: jax.Array,
     edge_live: jax.Array,   # bool[N, K] valid slot AND remote alive (cached)
     alive: jax.Array,
     scores: jax.Array,      # f32[N, K] my view of each neighbor slot
-    msg_valid: jax.Array,   # bool[M]
+    gossip_msgs: jax.Array,  # bool[M] advertisable window (valid & recent)
     p: GossipSubParams,
     gossip_threshold: float,
 ) -> jax.Array:
-    """Heartbeat-time IHAVE/IWANT: each peer advertises its window to
-    ``d_lazy`` random non-mesh neighbors scoring above the gossip threshold;
-    targets pull what they miss.  Returns bool[N, M]: messages to deliver via
-    gossip next round.
+    """Heartbeat IHAVE phase -> adv bool[N, K, M]: ``adv[i, s]`` is the set of
+    message ids advertised TO peer i BY its neighbor slot s this heartbeat.
 
-    The two-message exchange is fused: target t pulls ``have[i] & ~have[t]``
-    directly.  Only valid messages transfer (invalid ones died at their first
-    validation and were never cached).
+    ``gossip_msgs`` restricts advertisements to the ``history_gossip`` recent
+    windows (the mcache rule); ``cap_ihave`` enforces ``max_ihave_length``.
+    The receiver computes its IWANT against this snapshot next round and the
+    transfer lands the round after — the wire protocol's two message hops.
+
+    Formulated target-side as a reverse-index gather (a chooser's target is
+    always a slot-paired neighbor): gathers partition under GSPMD where the
+    equivalent scatter would serialize — this is what lets the sharded
+    100k-peer sim ride ICI collectives.
     """
     n, k = nbrs.shape
-    d_lazy = min(p.d_lazy, k)
-    if d_lazy <= 0:  # gossip disabled (a negative index would wrap: pick all)
-        return jnp.zeros_like(have)
-    eligible = (
-        edge_live & ~mesh & alive[:, None] & (scores >= gossip_threshold)
+    chosen = gossip_emission_mask(
+        key, mesh, edge_live, alive, scores, p, gossip_threshold
     )
-    # Random top-d_lazy among eligible slots.
-    r = jax.random.uniform(key, (n, k))
-    chosen = top_mask(jnp.where(eligible, r, -jnp.inf), d_lazy)
+    jidx = jnp.clip(nbrs, 0, n - 1)
+    ridx = jnp.clip(rev, 0, k - 1)
+    towards_me = chosen[jidx, ridx] & edge_live               # bool[N, K]
+    adv = towards_me[:, :, None] & (have & gossip_msgs[None, :])[jidx]
+    return cap_ihave(adv, p.max_ihave_length)
 
-    # Scatter-or into targets: pend[t, m] |= have[i, m] & ~have[t, m].
-    t = jnp.where(chosen, nbrs, n).reshape(-1)                    # i32[N*K]
-    src_have = jnp.repeat(have, k, axis=0)                        # bool[N*K, M]
-    lacks = ~safe_gather(have, jnp.clip(t, 0, n - 1), True)
-    offer = src_have & lacks & (t < n)[:, None] & msg_valid[None, :]
-    pend = jnp.zeros((n + 1, have.shape[1]), jnp.int32)
-    pend = pend.at[t].add(offer.astype(jnp.int32), mode="drop")
-    return pend[:n] > 0
+
+def masked_median(vals: jax.Array, mask: jax.Array) -> jax.Array:
+    """Per-row median of ``vals`` over ``mask`` -> f32[N]; +inf where the mask
+    is empty (callers compare with ``<`` so empty rows never trigger)."""
+    k = vals.shape[1]
+    cnt = mask.sum(axis=1)
+    s = jnp.sort(jnp.where(mask, vals, jnp.inf), axis=1)
+    idx = jnp.clip((cnt - 1) // 2, 0, k - 1)
+    med = jnp.take_along_axis(s, idx[:, None], axis=1)[:, 0]
+    return jnp.where(cnt > 0, med, jnp.inf)
 
 
 def heartbeat_mesh(
@@ -142,6 +209,8 @@ def heartbeat_mesh(
     alive: jax.Array,
     p: GossipSubParams,
     backoff: Optional[jax.Array] = None,  # i32[N, K] heartbeats left
+    outbound: Optional[jax.Array] = None,  # bool[N, K] I dialed this edge
+    do_opportunistic=False,  # bool scalar: opportunistic-graft tick
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Mesh maintenance: prune negative-score and over-degree links, graft
     toward D from well-scored candidates, then symmetrize edge state.
@@ -151,9 +220,18 @@ def heartbeat_mesh(
     Desired-set rules (each side computes independently, then edges agree):
     - drop slots whose score < 0 or whose remote died;
     - when degree > d_hi: keep the d_score best-scoring plus a random fill
-      back to D (spec's oversubscription rule);
+      back to D, with at least ``d_out`` outbound links retained (swap
+      random inbound fills for kept outbound ones if needed) — the spec's
+      oversubscription + outbound-quota rule;
     - when degree < d_lo: graft random non-mesh candidates with score >= 0
-      up to D, skipping slots inside their prune-backoff window.
+      up to D (the spec's hysteresis: no topping-up between d_lo and d),
+      skipping slots inside their prune-backoff window;
+    - regardless of degree, graft outbound candidates while the outbound
+      quota ``d_out`` is unmet;
+    - on an opportunistic tick, a peer whose median kept-mesh score is below
+      ``opportunistic_graft_threshold`` grafts up to
+      ``opportunistic_graft_peers`` candidates scoring above that median.
+
     Edge agreement: an existing edge survives only if BOTH sides keep it; a
     new edge forms if EITHER side grafts and the other side's view of the
     requester is non-negative (GRAFT accepted) — the array form of
@@ -165,6 +243,8 @@ def heartbeat_mesh(
     n, k = nbrs.shape
     if backoff is None:
         backoff = jnp.zeros((n, k), jnp.int32)
+    if outbound is None:
+        outbound = jnp.zeros((n, k), bool)
     # Own-liveness folded in makes kmask SYMMETRIC across the slot pairing
     # (valid & alive[i] & alive[j]), so the agreement rules below produce a
     # symmetric mesh by construction — no enforcement gather needed.
@@ -173,31 +253,79 @@ def heartbeat_mesh(
     keep = mesh & kmask & (scores >= 0.0)
     deg = keep.sum(axis=1)
 
-    kkeep, kgraft = jax.random.split(key)
+    kkeep, kgraft, kog = jax.random.split(key, 3)
 
     # Oversubscription: keep the d_score best-scoring slots unconditionally,
     # fill the remaining D - d_score UNIFORMLY AT RANDOM from the other kept
     # slots (the spec's rule; pure score-ranking would let an attacker who
     # inflates P1/P2 deterministically occupy every retained slot — the
-    # eclipse vector the random fill exists to break).
+    # eclipse vector the random fill exists to break), then enforce the
+    # outbound quota: if fewer than d_out of the chosen are outbound, swap
+    # random non-outbound fills for kept outbound slots.
     noise = jax.random.uniform(kkeep, (n, k), minval=0.0, maxval=1e-3)
     best = top_mask(jnp.where(keep, scores + noise, -jnp.inf), p.d_score)
     fill = top_mask(
         jnp.where(keep & ~best, noise, -jnp.inf), max(p.d - p.d_score, 0)
     )
+    chosen = best | fill
+    if p.d_out > 0:
+        ob_short = jnp.clip(
+            p.d_out - (chosen & outbound).sum(axis=1), 0, p.d_out
+        ).astype(jnp.int32)
+        add_ob = top_mask(
+            jnp.where(keep & outbound & ~chosen, noise, -jnp.inf),
+            ob_short,
+            kmax=p.d_out,
+        )
+        n_added = add_ob.sum(axis=1).astype(jnp.int32)
+        drop = top_mask(
+            jnp.where(fill & ~outbound, noise, -jnp.inf), n_added, kmax=p.d_out
+        )
+        chosen = (chosen | add_ob) & ~drop
     over = deg > p.d_hi
-    keep = keep & jnp.where(over[:, None], best | fill, True)
+    keep = keep & jnp.where(over[:, None], chosen, True)
 
-    # Grafting: random eligible non-mesh candidates up to D.  My own backoff
-    # gates candidacy; the REMOTE's backoff vetoes acceptance below (the
-    # wire analog: a GRAFT inside the peer's backoff window is refused).
+    # Grafting: random eligible non-mesh candidates up to D, only when degree
+    # fell below d_lo (spec hysteresis).  My own backoff gates candidacy; the
+    # REMOTE's backoff vetoes acceptance below (the wire analog: a GRAFT
+    # inside the peer's backoff window is refused).
     deg_now = keep.sum(axis=1)
-    want_more = jnp.maximum(p.d - deg_now, 0).astype(jnp.int32)
     score_ok = scores >= 0.0
     bo_ok = backoff <= 0
     cand = kmask & ~keep & score_ok & bo_ok
     r = jax.random.uniform(kgraft, (n, k))
+    want_more = jnp.where(
+        deg_now < p.d_lo, jnp.maximum(p.d - deg_now, 0), 0
+    ).astype(jnp.int32)
     graft = top_mask(jnp.where(cand, r, -jnp.inf), want_more, kmax=p.d)
+
+    # Outbound-quota grafting (v1.1): top up dialed-by-me mesh links to d_out
+    # even at full degree.
+    if p.d_out > 0:
+        ob_have = ((keep | graft) & outbound).sum(axis=1)
+        want_ob = jnp.clip(p.d_out - ob_have, 0, p.d_out).astype(jnp.int32)
+        graft = graft | top_mask(
+            jnp.where(cand & outbound & ~graft, r, -jnp.inf),
+            want_ob,
+            kmax=p.d_out,
+        )
+
+    # Opportunistic grafting (v1.1): median kept-mesh score below the
+    # threshold -> graft above-median candidates.
+    if p.opportunistic_graft_peers > 0:
+        med = masked_median(scores, keep)
+        og_on = jnp.asarray(do_opportunistic) & (
+            med < p.opportunistic_graft_threshold
+        )
+        og_want = jnp.where(og_on, p.opportunistic_graft_peers, 0).astype(
+            jnp.int32
+        )
+        rog = jax.random.uniform(kog, (n, k))
+        graft = graft | top_mask(
+            jnp.where(cand & ~graft & (scores > med[:, None]), rog, -jnp.inf),
+            og_want,
+            kmax=p.opportunistic_graft_peers,
+        )
 
     # Edge agreement via the reverse index.  For my slot (i, k) pointing at
     # j = nbrs[i, k], the remote's matching slot is (j, rev[i, k]); indexing

@@ -9,13 +9,14 @@ the message window packed into uint32 words (``ops/bitpack.py``):
   delivery counting is ``lax.population_count``, and first-delivering-slot
   attribution is an exclusive cumulative-OR over the slot axis
   (Hillis–Steele, log2 K steps — no serial scan).
-- ``gossip_transfer_packed`` — heartbeat IHAVE/IWANT.  Reformulated from the
-  reference's scatter-add into a **reverse-index gather**: a gossip target is
-  always a slot-paired neighbor, so "peers push to chosen targets" is
-  equivalently "each peer pulls from neighbors whose choice points back at
-  it" via ``chosen[nbrs[t,s], rev[t,s]]``.  Gathers partition cleanly under
-  GSPMD (scatters serialize); this is what lets the sharded 100k-peer sim
-  ride ICI collectives.
+- ``ihave_advertise_packed`` / ``iwant_requests_packed`` — the two-phase
+  heartbeat IHAVE/IWANT.  Reformulated from a scatter-add into a
+  **reverse-index gather**: a gossip target is always a slot-paired
+  neighbor, so "peers push to chosen targets" is equivalently "each peer
+  pulls from neighbors whose choice points back at it" via
+  ``chosen[nbrs[t,s], rev[t,s]]``.  Gathers partition cleanly under GSPMD
+  (scatters serialize); this is what lets the sharded 100k-peer sim ride
+  ICI collectives.
 
 The fused-downstream compute (everything after the XLA row gather) also has a
 Pallas TPU kernel form in ``ops/pallas_gossip.py``; these jnp versions are
@@ -113,7 +114,16 @@ def propagate_packed(
     )
 
 
-def gossip_transfer_packed(
+def cap_ihave_packed(adv_w: jax.Array, max_len: int) -> jax.Array:
+    """Word-granular ``max_ihave_length`` cap over packed advertisements
+    (u32[..., W]): keep whole words while the cumulative popcount fits.
+    Bit-identical to ``gossip.cap_ihave`` on the unpacked form."""
+    counts = jax.lax.population_count(adv_w).astype(jnp.int32)
+    cum = jnp.cumsum(counts, axis=-1)
+    return adv_w & _as_mask(cum <= max_len)
+
+
+def ihave_advertise_packed(
     key: jax.Array,
     have_w: jax.Array,     # u32[N, W]
     mesh: jax.Array,       # bool[N, K]
@@ -122,33 +132,50 @@ def gossip_transfer_packed(
     edge_live: jax.Array,  # bool[N, K] valid slot AND remote alive (cached)
     alive: jax.Array,      # bool[N]
     scores: jax.Array,     # f32[N, K]
-    valid_w: jax.Array,    # u32[W]
+    gossip_w: jax.Array,   # u32[W] packed advertisable window (valid & recent)
     p: GossipSubParams,
     gossip_threshold: float,
 ) -> jax.Array:
-    """Heartbeat IHAVE/IWANT over packed windows -> pending u32[N, W].
+    """Heartbeat IHAVE phase over packed windows -> adv u32[N, K, W]:
+    ``adv[i, s]`` is what neighbor slot s advertised TO peer i.
 
-    Choice rule is identical to ``gossip.gossip_transfer``: each live peer
-    advertises to ``d_lazy`` random non-mesh, live, above-threshold neighbor
-    slots.  Delivery is computed target-side by the reverse-index gather
-    described in the module docstring.
+    Choice rule is identical to ``gossip.ihave_advertise`` (adaptive
+    ``gossip_factor`` emission, ``history_gossip`` window via ``gossip_w``,
+    ``max_ihave_length`` cap).  The IWANT request and the transfer are the
+    caller's next two propagate rounds — the wire protocol's two hops.
     """
+    from .gossip import gossip_emission_mask
+
     n, k = nbrs.shape
     d_lazy = min(p.d_lazy, k)
     if d_lazy <= 0:
-        return jnp.zeros_like(have_w)
-    eligible = (
-        edge_live & ~mesh & alive[:, None] & (scores >= gossip_threshold)
+        return jnp.zeros(
+            (n, k, have_w.shape[1]), jnp.uint32
+        )
+    chosen = gossip_emission_mask(
+        key, mesh, edge_live, alive, scores, p, gossip_threshold
     )
-    r = jax.random.uniform(key, (n, k))
-    chosen = top_mask(jnp.where(eligible, r, -jnp.inf), d_lazy)
-
     # Target side: neighbor j = nbrs[t, s] chose me iff chosen[j, rev[t, s]].
     jidx = jnp.clip(nbrs, 0, n - 1)
     ridx = jnp.clip(rev, 0, k - 1)
     towards_me = chosen[jidx, ridx] & edge_live                    # bool[N, K]
-    offered = _as_mask(towards_me)[:, :, None] & have_w[jidx]      # u32[N, K, W]
-    offered = jax.lax.reduce(
-        offered, jnp.uint32(0), jax.lax.bitwise_or, dimensions=(1,)
+    adv = _as_mask(towards_me)[:, :, None] & (have_w & gossip_w[None, :])[jidx]
+    return cap_ihave_packed(adv, p.max_ihave_length)
+
+
+def iwant_requests_packed(
+    adv_w: jax.Array,      # u32[N, K, W] advertisements received last heartbeat
+    have_w: jax.Array,     # u32[N, W]
+    edge_live: jax.Array,  # bool[N, K]
+    alive: jax.Array,      # bool[N]
+) -> jax.Array:
+    """IWANT phase -> pending u32[N, W]: what each peer pulls from its
+    advertisers (messages offered that it still lacks, over edges still
+    live).  The transfer lands next round via the caller's pend fold — the
+    advertiser's mcache retention (``history_length > history_gossip``)
+    guarantees it can still serve the request."""
+    want = adv_w & ~have_w[:, None, :] & _as_mask(edge_live)[:, :, None]
+    pend = jax.lax.reduce(
+        want, jnp.uint32(0), jax.lax.bitwise_or, dimensions=(1,)
     )
-    return offered & ~have_w & valid_w & _as_mask(alive)[:, None]
+    return pend & _as_mask(alive)[:, None]

@@ -1,0 +1,123 @@
+"""Peer exchange on prune (GossipSub v1.1 PX) as a topology-rewire kernel.
+
+When a peer prunes a mesh link for oversubscription, the spec has it include
+a sample of its own mesh peers in the PRUNE so the pruned side can form new
+connections — the mechanism that keeps a mesh from fragmenting as degrees
+are trimmed.  The v0 reference has no notion of this (its tree repair dials
+recorded grandchildren instead, ``/root/reference/subtree.go:356-375``); here
+PX is the one operation that MUTATES the otherwise-static neighbor-slot
+adjacency: a new (i, m) edge is written into a free slot on both endpoints.
+
+Spec gates, both enforced score-side:
+
+- the pruner only offers PX to peers it scores >= 0 (no feeding peers to a
+  misbehaving node);
+- the pruned peer only accepts PX from pruners it scores
+  >= ``accept_px_threshold`` (``ScoreParams``) — a sybil cannot use PRUNE-PX
+  to steer a victim toward attacker peers unless it first earned that score.
+
+Parallel-conflict discipline (everything happens in one jitted heartbeat):
+at most one PX connection forms per initiator and per acceptor per
+heartbeat; an acceptor is never itself an initiator.  Winners are chosen by
+a scatter-min over initiator ids, so every write below touches a distinct
+(row, slot) and the slot-pairing invariant ``nbrs[m, rev[i,s]] == i`` is
+preserved by construction.  Runs at heartbeat rate, far off the propagate
+hot path.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class PxOut(NamedTuple):
+    nbrs: jax.Array       # i32[N, K]
+    rev: jax.Array        # i32[N, K]
+    nbr_valid: jax.Array  # bool[N, K]
+    outbound: jax.Array   # bool[N, K] (initiator side of a PX edge dials)
+    backoff: jax.Array    # i32[N, K] (reset on the new slots)
+    connected: jax.Array  # bool[N] diagnostic: peer initiated a PX edge
+
+
+def px_rewire(
+    key: jax.Array,
+    nbrs: jax.Array,       # i32[N, K]
+    rev: jax.Array,        # i32[N, K]
+    nbr_valid: jax.Array,  # bool[N, K]
+    outbound: jax.Array,   # bool[N, K]
+    backoff: jax.Array,    # i32[N, K]
+    mesh: jax.Array,       # bool[N, K] POST-heartbeat mesh (the PX sample pool)
+    pruned: jax.Array,     # bool[N, K] edges pruned this heartbeat
+    scores: jax.Array,     # f32[N, K]
+    alive: jax.Array,      # bool[N]
+    accept_px_threshold: float,
+) -> PxOut:
+    """One PX round: each pruned peer may open one new connection to a
+    random mesh neighbor of its pruner.  Returns the rewired adjacency."""
+    n, k = nbrs.shape
+    jidx = jnp.clip(nbrs, 0, n - 1)
+    ridx = jnp.clip(rev, 0, k - 1)
+    peer_ids = jnp.arange(n, dtype=jnp.int32)
+
+    # Which pruned slots carry an acceptable PX offer.
+    offer_ok = scores[jidx, ridx] >= 0.0          # pruner j offers (its view of me)
+    accept_ok = scores >= accept_px_threshold     # I trust pruner j enough
+    px_edge = pruned & offer_ok & accept_ok & nbr_valid
+    has_px = px_edge.any(axis=1)
+    s_sel = jnp.argmax(px_edge, axis=1).astype(jnp.int32)  # first offering slot
+    j_sel = jidx[peer_ids, s_sel]                          # the pruner, i32[N]
+
+    # Candidate m: a uniformly random CURRENT mesh neighbor of the pruner
+    # (the spec's "sample of my mesh" in the PRUNE).
+    mesh_j = mesh[j_sel]                                   # bool[N, K] row gather
+    rnd = jax.random.uniform(key, (n, k))
+    cand_slot = jnp.argmax(jnp.where(mesh_j, rnd, -jnp.inf), axis=1)
+    has_cand = mesh_j.any(axis=1)
+    m = jidx[j_sel, cand_slot.astype(jnp.int32)]           # i32[N]
+
+    # Initiator validity: a live peer with a PX offer, a usable candidate
+    # that is alive, not itself, not already a neighbor, and a free slot.
+    already = ((nbrs == m[:, None]) & nbr_valid).any(axis=1)
+    free_cnt = (~nbr_valid).sum(axis=1)
+    init = (
+        has_px
+        & has_cand
+        & alive
+        & alive[m]
+        & (m != peer_ids)
+        & ~already
+        & (free_cnt > 0)
+    )
+    # Acceptors must not be initiators (each row is written at most once).
+    init = init & ~init[m]
+    init = init & (free_cnt[m] > 0)
+
+    # One initiator per acceptor: scatter-min of initiator ids onto targets.
+    tgt = jnp.where(init, m, n)
+    winner = (
+        jnp.full((n + 1,), n, jnp.int32).at[tgt].min(peer_ids, mode="drop")
+    )
+    win = init & (winner[tgt] == peer_ids)
+
+    # Slot assignment: first free slot on each side.
+    fi = jnp.argmax(~nbr_valid, axis=1).astype(jnp.int32)  # mine
+    fm = fi[m]                                             # the acceptor's
+
+    rows_i = jnp.where(win, peer_ids, n)
+    rows_m = jnp.where(win, m, n)
+
+    nbrs = nbrs.at[rows_i, fi].set(m, mode="drop")
+    nbrs = nbrs.at[rows_m, fm].set(peer_ids, mode="drop")
+    rev = rev.at[rows_i, fi].set(fm, mode="drop")
+    rev = rev.at[rows_m, fm].set(fi, mode="drop")
+    nbr_valid = nbr_valid.at[rows_i, fi].set(True, mode="drop")
+    nbr_valid = nbr_valid.at[rows_m, fm].set(True, mode="drop")
+    outbound = outbound.at[rows_i, fi].set(True, mode="drop")
+    outbound = outbound.at[rows_m, fm].set(False, mode="drop")
+    backoff = backoff.at[rows_i, fi].set(0, mode="drop")
+    backoff = backoff.at[rows_m, fm].set(0, mode="drop")
+
+    return PxOut(nbrs, rev, nbr_valid, outbound, backoff, win)
