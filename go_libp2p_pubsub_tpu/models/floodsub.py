@@ -44,7 +44,7 @@ class FloodSub:
 
     def init(self, seed: int = 0) -> FloodState:
         rng = np.random.default_rng(seed)
-        nbrs, _, valid = build_topology(rng, self.n, self.k, self.conn_degree)
+        nbrs, _, valid, _ = build_topology(rng, self.n, self.k, self.conn_degree)
         n, m = self.n, self.m
         return FloodState(
             nbrs=jnp.asarray(nbrs, jnp.int32),

@@ -454,6 +454,7 @@ class GossipSub:
         new_mesh, grafted, pruned, backoff = heartbeat_mesh(
             khb, st.mesh, scores, st.nbrs, st.rev, edge_ok, part, p,
             st.backoff, st.outbound, do_og,
+            og_threshold=sp.opportunistic_graft_threshold,
         )
         c = scoring_ops.on_prune(c, pruned, sp)
         c = scoring_ops.on_graft(c, grafted)
@@ -553,12 +554,15 @@ class GossipSub:
 
     def _propagate(self, st: GossipState) -> GossipState:
         # Fold due gossip/flood deliveries (requested or offered last round)
-        # into this round's receipts.
+        # into this round's receipts.  These copies arrive this round and
+        # relay NEXT round (they join fresh_w after the eager push below) —
+        # merging them into the relayed set here would move a message two
+        # hops in one round, which both breaks wire parity and zeroes the
+        # measured hop latency.
         gossip_new = (
             st.gossip_pend_w & ~st.have_w & gossip_ops._as_mask(st.alive)[:, None]
         )
         have_w = st.have_w | gossip_new
-        fresh_w = st.fresh_w | gossip_new
         first_step = jnp.where(
             bitpack.unpack(gossip_new, self.m) & (st.first_step < 0),
             st.step,
@@ -583,13 +587,14 @@ class GossipSub:
             from ..ops.pallas_gossip import propagate_packed_pallas
 
             out = propagate_packed_pallas(
-                relay_mesh, st.nbrs, st.edge_live, st.alive, have_w, fresh_w,
-                valid_w, interpret=jax.default_backend() != "tpu",
+                relay_mesh, st.nbrs, st.edge_live, st.alive, have_w,
+                st.fresh_w, valid_w,
+                interpret=jax.default_backend() != "tpu",
             )
         else:
             out = gossip_ops.propagate_packed(
-                relay_mesh, st.nbrs, st.edge_live, st.alive, have_w, fresh_w,
-                valid_w,
+                relay_mesh, st.nbrs, st.edge_live, st.alive, have_w,
+                st.fresh_w, valid_w,
             )
         first_step = jnp.where(
             bitpack.unpack(out.new_w, self.m) & (first_step < 0),
@@ -606,7 +611,8 @@ class GossipSub:
         )
         return st._replace(
             have_w=out.have_w,
-            fresh_w=out.fresh_w,
+            # Pend-fold arrivals relay on the NEXT round (one hop per round).
+            fresh_w=out.fresh_w | gossip_new,
             first_step=first_step,
             counters=c,
             gossip_pend_w=pend_next,

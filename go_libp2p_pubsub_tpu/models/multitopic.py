@@ -322,6 +322,7 @@ class MultiTopicGossipSub:
             new_mesh, grafted, pruned, bo2 = heartbeat_mesh(
                 khb, mesh_t, scores, st.nbrs, st.rev, el, al, p, bo_t,
                 st.outbound, do_og,
+                og_threshold=sp.opportunistic_graft_threshold,
             )
             c2 = scoring_ops.on_graft(
                 scoring_ops.on_prune(c_t, pruned, sp), grafted

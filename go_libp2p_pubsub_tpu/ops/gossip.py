@@ -20,8 +20,10 @@ v1.1 mechanisms implemented here (each read from ``GossipSubParams``):
   candidates even at full degree (the spec's eclipse defense: a victim whose
   mesh is all inbound attacker connections keeps some self-chosen links);
 - opportunistic grafting: every ``opportunistic_graft_ticks`` heartbeats, a
-  peer whose median mesh score sits below ``opportunistic_graft_threshold``
-  grafts ``opportunistic_graft_peers`` candidates scoring above that median
+  peer whose median mesh score sits below the threshold (passed in from
+  ``ScoreParams.opportunistic_graft_threshold`` — it is a score threshold,
+  so it lives with the other score thresholds) grafts
+  ``opportunistic_graft_peers`` candidates scoring above that median
   (breaks slow-eclipse meshes that keep scores just above zero);
 - two-phase IHAVE/IWANT: ``ihave_advertise`` emits heartbeat advertisements
   (an adjacency-slot-indexed window snapshot) honoring ``history_gossip``,
@@ -188,6 +190,23 @@ def ihave_advertise(
     return cap_ihave(adv, p.max_ihave_length)
 
 
+def iwant_requests(
+    adv: jax.Array,        # bool[N, K, M] advertisements received last heartbeat
+    have: jax.Array,       # bool[N, M]
+    edge_live: jax.Array,  # bool[N, K]
+    alive: jax.Array,      # bool[N]
+) -> jax.Array:
+    """IWANT phase -> pending bool[N, M]: what each peer pulls from its
+    advertisers (offered ids it still lacks, over edges still live).
+
+    Unpacked reference for ``gossip_packed.iwant_requests_packed``; the
+    transfer lands next round via the model's pend fold — two wire hops
+    after the IHAVE, as on the wire.
+    """
+    want = adv & ~have[:, None, :] & edge_live[:, :, None]
+    return want.any(axis=1) & alive[:, None]
+
+
 def masked_median(vals: jax.Array, mask: jax.Array) -> jax.Array:
     """Per-row median of ``vals`` over ``mask`` -> f32[N]; +inf where the mask
     is empty (callers compare with ``<`` so empty rows never trigger)."""
@@ -211,6 +230,7 @@ def heartbeat_mesh(
     backoff: Optional[jax.Array] = None,  # i32[N, K] heartbeats left
     outbound: Optional[jax.Array] = None,  # bool[N, K] I dialed this edge
     do_opportunistic=False,  # bool scalar: opportunistic-graft tick
+    og_threshold: float = 1.0,  # ScoreParams.opportunistic_graft_threshold
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Mesh maintenance: prune negative-score and over-degree links, graft
     toward D from well-scored candidates, then symmetrize edge state.
@@ -229,8 +249,8 @@ def heartbeat_mesh(
     - regardless of degree, graft outbound candidates while the outbound
       quota ``d_out`` is unmet;
     - on an opportunistic tick, a peer whose median kept-mesh score is below
-      ``opportunistic_graft_threshold`` grafts up to
-      ``opportunistic_graft_peers`` candidates scoring above that median.
+      ``og_threshold`` grafts up to ``opportunistic_graft_peers``
+      candidates scoring above that median.
 
     Edge agreement: an existing edge survives only if BOTH sides keep it; a
     new edge forms if EITHER side grafts and the other side's view of the
@@ -314,9 +334,7 @@ def heartbeat_mesh(
     # threshold -> graft above-median candidates.
     if p.opportunistic_graft_peers > 0:
         med = masked_median(scores, keep)
-        og_on = jnp.asarray(do_opportunistic) & (
-            med < p.opportunistic_graft_threshold
-        )
+        og_on = jnp.asarray(do_opportunistic) & (med < og_threshold)
         og_want = jnp.where(og_on, p.opportunistic_graft_peers, 0).astype(
             jnp.int32
         )

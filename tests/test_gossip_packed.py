@@ -47,7 +47,7 @@ def test_bit_mask_and_get_bit():
 
 def _random_state(seed, n=64, k=16, m=96, degree=8):
     rng = np.random.default_rng(seed)
-    nbrs, rev, valid = build_topology(rng, n, k, degree)
+    nbrs, rev, valid, _ = build_topology(rng, n, k, degree)
     mesh = valid & (rng.random((n, k)) < 0.6)
     # Symmetrize mesh over the rev pairing.
     j = np.clip(nbrs, 0, n - 1)
@@ -103,7 +103,9 @@ def test_propagate_packed_matches_reference(seed):
 
 
 @pytest.mark.parametrize("seed", [0, 3])
-def test_gossip_transfer_packed_matches_reference(seed):
+def test_two_phase_gossip_packed_matches_reference(seed):
+    """IHAVE advertise + IWANT request: packed must be bit-exact with the
+    unpacked reference ops, phase by phase, under the SAME prng key."""
     mesh, nbrs, rev, valid, alive, have, fresh, msg_valid = _random_state(seed)
     n, m = have.shape
     k = nbrs.shape[1]
@@ -115,21 +117,36 @@ def test_gossip_transfer_packed_matches_reference(seed):
         np.asarray(valid)
         & np.asarray(alive)[np.clip(np.asarray(nbrs), 0, len(alive) - 1)]
     )
-    ref = ref_ops.gossip_transfer(
-        key, have, mesh, nbrs, edge_live, alive, scores, msg_valid, p, -0.5
+    # Phase 1: heartbeat IHAVE snapshot.
+    ref_adv = ref_ops.ihave_advertise(
+        key, have, mesh, nbrs, rev, edge_live, alive, scores, msg_valid,
+        p, -0.5,
     )
-    out = packed_ops.gossip_transfer_packed(
+    out_adv = packed_ops.ihave_advertise_packed(
         key, bitpack.pack(have), mesh, nbrs, rev, edge_live, alive, scores,
         bitpack.pack(msg_valid), p, -0.5,
     )
     np.testing.assert_array_equal(
-        np.asarray(bitpack.unpack(out, m)), np.asarray(ref)
+        np.asarray(bitpack.unpack(out_adv, m)), np.asarray(ref_adv)
     )
+    # Phase 2: IWANT pull against the snapshot.
+    ref_pend = ref_ops.iwant_requests(ref_adv, have, edge_live, alive)
+    out_pend = packed_ops.iwant_requests_packed(
+        out_adv, bitpack.pack(have), edge_live, alive
+    )
+    np.testing.assert_array_equal(
+        np.asarray(bitpack.unpack(out_pend, m)), np.asarray(ref_pend)
+    )
+    # Phase 3: the transfer is the model's pend fold — a requested id lands
+    # only where it was advertised and still missing.
+    pend = np.asarray(ref_pend)
+    assert not (pend & np.asarray(have)).any()
+    assert (pend <= np.asarray(ref_adv).any(axis=1)).all()
 
 
-def test_gossip_transfer_packed_disabled_when_d_lazy_zero():
+def test_ihave_advertise_packed_disabled_when_d_lazy_zero():
     mesh, nbrs, rev, valid, alive, have, fresh, msg_valid = _random_state(1)
-    out = packed_ops.gossip_transfer_packed(
+    out = packed_ops.ihave_advertise_packed(
         jax.random.PRNGKey(0), bitpack.pack(have), mesh, nbrs, rev, valid,
         alive, jnp.zeros_like(nbrs, jnp.float32), bitpack.pack(msg_valid),
         GossipSubParams(d_lazy=0), -10.0,
@@ -140,7 +157,7 @@ def test_gossip_transfer_packed_disabled_when_d_lazy_zero():
 def test_build_topology_fast_invariants():
     rng = np.random.default_rng(11)
     n, k, degree = 512, 24, 12
-    nbrs, rev, valid = build_topology_fast(rng, n, k, degree)
+    nbrs, rev, valid, outbound = build_topology_fast(rng, n, k, degree)
     # Slot pairing is symmetric: my slot's remote points back at me.
     for i in range(0, n, 37):
         for s in range(k):

@@ -20,7 +20,7 @@ def st0(gs):
 
 def test_topology_symmetry():
     rng = np.random.default_rng(3)
-    nbrs, rev, valid = build_topology(rng, 64, 16, 8)
+    nbrs, rev, valid, outbound = build_topology(rng, 64, 16, 8)
     n, k = nbrs.shape
     for i in range(n):
         for s in range(k):
@@ -139,17 +139,17 @@ def test_gossip_disabled_when_d_lazy_zero():
     wrapped around and selected every eligible neighbor instead)."""
     import jax
 
-    from go_libp2p_pubsub_tpu.ops.gossip import gossip_transfer
+    from go_libp2p_pubsub_tpu.ops.gossip import ihave_advertise
 
     gs = GossipSub(n_peers=32, n_slots=8, conn_degree=4)
     st = gs.init(seed=0)
     have = jnp.zeros((32, 8), bool).at[0, 0].set(True)
-    pend = gossip_transfer(
-        jax.random.PRNGKey(0), have, st.mesh, st.nbrs, st.edge_live,
+    adv = ihave_advertise(
+        jax.random.PRNGKey(0), have, st.mesh, st.nbrs, st.rev, st.edge_live,
         st.alive, st.scores, jnp.ones((8,), bool),
         GossipSubParams(d_lazy=0), -10.0,
     )
-    assert not bool(pend.any())
+    assert not bool(adv.any())
 
 
 def test_oversubscription_keeps_dscore_best_plus_random_fill():

@@ -16,7 +16,7 @@ from go_libp2p_pubsub_tpu.ops.pallas_gossip import TILE, propagate_packed_pallas
 
 def _state(seed, n, k=32, m=128, degree=12):
     rng = np.random.default_rng(seed)
-    nbrs, rev, valid = build_topology(rng, n, k, degree)
+    nbrs, rev, valid, _ = build_topology(rng, n, k, degree)
     mesh = valid & (rng.random((n, k)) < 0.6)
     j = np.clip(nbrs, 0, n - 1)
     mesh = mesh & mesh[j, np.clip(rev, 0, k - 1)]
