@@ -149,6 +149,17 @@ class ValidationPipeline:
         if len(self._pending) >= self.flush_threshold:
             self.flush()
 
+    def drop_pending(self) -> List[Envelope]:
+        """Discard and return envelopes awaiting verification.
+
+        For callers that keep their own copy of the batch: after a backend
+        failure ``flush`` re-queues internally, and a caller that will retry
+        by re-submitting must drop that requeue first or every envelope
+        would be verified (and its ``on_verdict`` fired) twice.
+        """
+        dropped, self._pending = self._pending, []
+        return dropped
+
     def flush(self) -> List[Tuple[Envelope, bool]]:
         if not self._pending:
             return []

@@ -155,8 +155,11 @@ def eclipse_attempt(
 
     Each round publishes ``msgs_per_round`` valid messages from random
     honest peers, then advances one heartbeat period with attacker relay
-    suppressed (their fresh words are zeroed after every step — alive and
-    scoreable, but mute).
+    suppressed on BOTH data planes: their fresh words are zeroed after
+    every step (no eager relay) AND their IHAVE advertisements are struck
+    from every honest peer's received-advertisement snapshot (no gossip
+    service either — a mute peer must not answer IWANTs).  Attackers stay
+    alive and scoreable throughout.
     """
     n, k = gs.n, gs.k
     nbrs_np = np.asarray(st.nbrs)
@@ -171,11 +174,27 @@ def eclipse_attempt(
     silence = jnp.where(
         attackers[:, None], jnp.uint32(0), jnp.uint32(0xFFFFFFFF)
     )
+    # adv_w[i, s] holds what neighbor slot s advertised TO peer i; slots
+    # whose remote is an attacker are muted so the IWANT round never pulls
+    # from them.  Recomputed from the CURRENT adjacency each time because PX
+    # rewires slots during heartbeats.
+    def _adv_silence(s):
+        att_slot = attackers[jnp.clip(s.nbrs, 0, n - 1)] & s.nbr_valid
+        return jnp.where(
+            att_slot, jnp.uint32(0), jnp.uint32(0xFFFFFFFF)
+        )[:, :, None]
+
+    # The warmup heartbeats before the trace may already have recorded
+    # attacker advertisements; strike those before the first round.
+    st = st._replace(adv_w=st.adv_w & _adv_silence(st))
 
     def body(s, _):
         s = gs.step(s)
-        # Attacker silence: drop anything they would relay next round.
-        s = s._replace(fresh_w=s.fresh_w & silence)
+        # Attacker silence: drop anything they would relay or serve next
+        # round (eager fresh words and their freshly recorded IHAVEs).
+        s = s._replace(
+            fresh_w=s.fresh_w & silence, adv_w=s.adv_w & _adv_silence(s)
+        )
         m = _attacker_metrics(gs, s, attackers)
         # Target-centric defense metric: mesh edges to honest peers.
         tgt_honest = (
@@ -205,3 +224,78 @@ def eclipse_attempt(
         for k_ in series[0]
     }
     return st, report, attackers
+
+
+def backoff_spam_attack(
+    n_peers: int = 64,
+    n_attackers: int = 6,
+    n_rounds: int = 8,
+    seed: int = 0,
+    **model_kwargs,
+) -> Tuple[GossipSub, GossipState, Dict[str, np.ndarray], jax.Array]:
+    """GRAFT flooders vs the P7 behaviour penalty.
+
+    Attackers spam invalid messages (so honest meshes prune them, starting
+    prune-backoff countdowns) AND re-graft straight through the backoff
+    window every heartbeat (``graft_spammers``).  Every refused attempt
+    charges their ``behaviour_penalty``; the squared P7 term must push their
+    score negative and keep them out of honest meshes even after the P4
+    spam evidence has decayed away.
+
+    Constructs its own model (the spammer set is constructor-bound — see
+    ``GossipSub.graft_spammers``).  Returns (model, final_state, report,
+    attacker_mask); the report adds ``attacker_behaviour_penalty`` and
+    ``attacker_global_score`` to the standard defense series.
+    """
+    from ..config import ScoreParams
+    from ..ops import scoring as scoring_ops
+
+    attackers_np = np.arange(n_peers) < n_attackers
+    sp = model_kwargs.pop("score_params", ScoreParams())
+    gs = GossipSub(
+        n_peers=n_peers,
+        score_params=sp,
+        graft_spammers=attackers_np,
+        **model_kwargs,
+    )
+    st = gs.init(seed=seed)
+    attackers = jnp.asarray(attackers_np)
+    rng = np.random.default_rng(seed)
+
+    def body(s, _):
+        s = gs.step(s)
+        m = _attacker_metrics(gs, s, attackers)
+        m["attacker_behaviour_penalty"] = jnp.where(
+            attackers, s.gcounters.behaviour_penalty, jnp.nan
+        ).max(where=attackers, initial=0.0)
+        m["attacker_global_score"] = jnp.nanmean(
+            jnp.where(
+                attackers, scoring_ops.global_score(s.gcounters, sp), jnp.nan
+            )
+        )
+        return s, m
+
+    series = []
+    slot = 0
+    for _ in range(n_rounds):
+        # Attacker spam earns the prunes; one honest publish keeps honest
+        # P2 credit flowing.
+        for a in range(n_attackers):
+            st = gs.publish(
+                st, jnp.int32(a), jnp.int32(slot % gs.m), jnp.asarray(False)
+            )
+            slot += 1
+        st = gs.publish(
+            st,
+            jnp.int32(int(rng.integers(n_attackers, n_peers))),
+            jnp.int32(slot % gs.m),
+            jnp.asarray(True),
+        )
+        slot += 1
+        st, s = jax.lax.scan(body, st, None, length=gs.heartbeat_steps)
+        series.append(jax.device_get(s))
+    report = {
+        k_: np.concatenate([np.asarray(s[k_]) for s in series])
+        for k_ in series[0]
+    }
+    return gs, st, report, attackers

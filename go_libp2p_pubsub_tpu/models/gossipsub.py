@@ -191,13 +191,19 @@ def compute_edge_live(
 
 
 def seed_message(
-    have_w, fresh_w, gossip_pend_w, first_step,
+    have_w, fresh_w, gossip_pend_w, adv_w, first_step,
     msg_valid, msg_birth, msg_active, msg_used,
     src, slot, valid, step, w,
 ):
     """Window-slot recycle + seed, shared by the single- and multi-topic
     models: clear the slot's bits for ALL peers (slot reuse), then stamp the
-    publisher.  Returns the eight updated window leaves in argument order."""
+    publisher.  Returns the nine updated window leaves in argument order.
+
+    ``adv_w`` (the IHAVE snapshot awaiting its IWANT round) must be cleared
+    too: a stale advertisement for the OLD message in a recycled slot would
+    otherwise turn into a phantom IWANT delivery of the NEW message — peers
+    would record first receipts for bytes they never received.
+    """
     bm = bitpack.bit_mask(slot, w)               # u32[W] one-hot
     have_w = have_w & ~bm
     fresh_w = fresh_w & ~bm
@@ -205,6 +211,7 @@ def seed_message(
         have_w.at[src].set(have_w[src] | bm),
         fresh_w.at[src].set(fresh_w[src] | bm),
         gossip_pend_w & ~bm,
+        adv_w & ~bm[None, None, :],
         first_step.at[:, slot].set(-1).at[src, slot].set(step),
         msg_valid.at[slot].set(valid),
         msg_birth.at[slot].set(step),
@@ -227,6 +234,7 @@ class GossipSub:
         heartbeat_steps: int = 8,
         use_pallas: Optional[bool] = None,
         builder=None,
+        graft_spammers: Optional[np.ndarray] = None,
     ):
         self.n = n_peers
         self.k = n_slots
@@ -237,6 +245,13 @@ class GossipSub:
         self.score_params = score_params or ScoreParams()
         self.heartbeat_steps = heartbeat_steps
         self.builder = builder  # explicit topology builder (seed pinning)
+        # Misbehaviour model (attack traces): bool[N] of peers that GRAFT
+        # through their own prune-backoff window; their refused attempts
+        # accrue the P7 behaviour penalty each heartbeat.  Constructor-bound
+        # (not mutable state) so the jit cache never sees it change.
+        self.graft_spammers = (
+            None if graft_spammers is None else jnp.asarray(graft_spammers)
+        )
         # Pallas fast path: unsharded TPU arrays only.  The jnp ops partition
         # under GSPMD for the peer-sharded sim (see parallel/), while a
         # pallas_call would need shard_map — sharded runners must pass
@@ -355,9 +370,9 @@ class GossipSub:
         """
         p, sp = self.params, self.score_params
         n, k = self.n, self.k
-        (have_w, fresh_w, pend_w, first_step,
+        (have_w, fresh_w, pend_w, adv_w, first_step,
          mv, mb, ma, mu) = seed_message(
-            st.have_w, st.fresh_w, st.gossip_pend_w, st.first_step,
+            st.have_w, st.fresh_w, st.gossip_pend_w, st.adv_w, st.first_step,
             st.msg_valid, st.msg_birth, st.msg_active, st.msg_used,
             src, slot, valid, st.step, self.w,
         )
@@ -399,7 +414,7 @@ class GossipSub:
         pend_w = pend_w.at[rows].set(upd, mode="drop")
         return st._replace(
             have_w=have_w, fresh_w=fresh_w, gossip_pend_w=pend_w,
-            first_step=first_step, msg_valid=mv, msg_birth=mb,
+            adv_w=adv_w, first_step=first_step, msg_valid=mv, msg_birth=mb,
             msg_active=ma, msg_used=mu, fanout=fanout,
             fanout_age=fanout_age, key=knext,
         )
@@ -451,13 +466,18 @@ class GossipSub:
         hb_idx = st.step // self.heartbeat_steps
         do_og = (hb_idx % p.opportunistic_graft_ticks) == 0
 
-        new_mesh, grafted, pruned, backoff = heartbeat_mesh(
+        new_mesh, grafted, pruned, backoff, bo_violations = heartbeat_mesh(
             khb, st.mesh, scores, st.nbrs, st.rev, edge_ok, part, p,
             st.backoff, st.outbound, do_og,
             og_threshold=sp.opportunistic_graft_threshold,
+            ignore_backoff=self.graft_spammers,
         )
         c = scoring_ops.on_prune(c, pruned, sp)
         c = scoring_ops.on_graft(c, grafted)
+        # P7: charge backoff-violating GRAFT attempts to their sender; the
+        # squared penalty lands in everyone's view of that peer at the next
+        # score refresh.
+        g = g._replace(behaviour_penalty=g.behaviour_penalty + bo_violations)
 
         # Peer exchange on prune (v1.1 PX): pruned peers may open one new
         # connection toward a mesh neighbor of their pruner, gated by

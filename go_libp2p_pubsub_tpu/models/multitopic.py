@@ -181,13 +181,15 @@ class MultiTopicGossipSub:
 
         p, sp = self.params, self.score_params
         n, k = self.n, self.k
-        (have_t, fresh_t, pend_t, fs_t, mv, mb, ma, mu) = seed_message(
+        (have_t, fresh_t, pend_t, adv_t, fs_t, mv, mb, ma, mu) = seed_message(
             st.have_w[topic], st.fresh_w[topic], st.gossip_pend_w[topic],
-            st.first_step[topic], st.msg_valid[topic], st.msg_birth[topic],
-            st.msg_active[topic], st.msg_used[topic],
+            st.adv_w[topic], st.first_step[topic], st.msg_valid[topic],
+            st.msg_birth[topic], st.msg_active[topic], st.msg_used[topic],
             src, slot, valid, st.step, self.w,
         )
-        kpub = jax.random.fold_in(st.keys[topic], st.step)
+        # Advance the topic's key so back-to-back publishes within one step
+        # draw fresh fanout randomness (mirrors the single-topic split).
+        kpub, knext = jax.random.split(st.keys[topic])
         eligible = st.edge_live[topic][src] & (
             st.scores[src] >= sp.publish_threshold
         )
@@ -224,6 +226,7 @@ class MultiTopicGossipSub:
             have_w=st.have_w.at[topic].set(have_t),
             fresh_w=st.fresh_w.at[topic].set(fresh_t),
             gossip_pend_w=st.gossip_pend_w.at[topic].set(pend_t),
+            adv_w=st.adv_w.at[topic].set(adv_t),
             first_step=st.first_step.at[topic].set(fs_t),
             msg_valid=st.msg_valid.at[topic].set(mv),
             msg_birth=st.msg_birth.at[topic].set(mb),
@@ -231,6 +234,7 @@ class MultiTopicGossipSub:
             msg_used=st.msg_used.at[topic].set(mu),
             fanout=fanout,
             fanout_age=fanout_age,
+            keys=st.keys.at[topic].set(knext),
         )
 
     @functools.partial(jax.jit, static_argnums=0)
@@ -319,10 +323,11 @@ class MultiTopicGossipSub:
         def one(mesh_t, fan_t, fage_t, bo_t, c_t, have_t, pend_t, mv, ma,
                 mbirth, mused, k4, al, el, sub_t):
             khb, kgossip, kfan, knext = k4
-            new_mesh, grafted, pruned, bo2 = heartbeat_mesh(
+            new_mesh, grafted, pruned, bo2, bo_viol = heartbeat_mesh(
                 khb, mesh_t, scores, st.nbrs, st.rev, el, al, p, bo_t,
                 st.outbound, do_og,
                 og_threshold=sp.opportunistic_graft_threshold,
+                ignore_backoff=self.gs.graft_spammers,
             )
             c2 = scoring_ops.on_graft(
                 scoring_ops.on_prune(c_t, pruned, sp), grafted
@@ -367,14 +372,19 @@ class MultiTopicGossipSub:
                 have_t & ~bitpack.pack(seen_expired),
                 pend_t & ~dead_w[None, :],
                 adv & ~dead_w[None, None, :],
-                ma & ~expired, knext,
+                ma & ~expired, knext, bo_viol,
             )
 
         (mesh, fanout, fanout_age, backoff, c, have_w, pend, adv_w, mactive,
-         keys) = jax.vmap(one)(
+         keys, bo_viols) = jax.vmap(one)(
             st.mesh, st.fanout, st.fanout_age, st.backoff, c, st.have_w,
             st.gossip_pend_w, st.msg_valid, st.msg_active, st.msg_birth,
             st.msg_used, keys4, topic_alive, st.edge_live, st.subscribed,
+        )
+        # P7 is a GLOBAL component: backoff-violating GRAFTs in any topic
+        # accrue to the sender's one behaviour-penalty counter.
+        g = g._replace(
+            behaviour_penalty=g.behaviour_penalty + bo_viols.sum(axis=0)
         )
         return st._replace(
             mesh=mesh, fanout=fanout, fanout_age=fanout_age, backoff=backoff,

@@ -119,17 +119,32 @@ class _BatchValidator:
             envs = [e for _, e in batch if e is not None]
             for e in envs:
                 self.pipeline.submit(e)
-            results = (
-                await loop.run_in_executor(None, self.pipeline.flush)
-                if envs
-                else []
-            )
-            verdicts = iter(results)
+            try:
+                results = (
+                    await loop.run_in_executor(None, self.pipeline.flush)
+                    if envs
+                    else []
+                )
+            except Exception:
+                # Backend infrastructure failure: the pipeline re-queued its
+                # envelopes internally; drop that requeue (we still hold the
+                # frames) and put the batch back at the head of our queue so
+                # the next flush re-pairs every frame with its own verdict —
+                # nothing is lost and later batches cannot misalign against
+                # leftover verdicts.
+                self.pipeline.drop_pending()
+                self._queue = batch + self._queue
+                raise
+            # Match verdicts by envelope identity, never by position: a
+            # partial failure path that leaves the pipeline and this loop
+            # holding different batch views must fail closed (missing
+            # verdict == rejected), not shift credit across envelopes.
+            verdicts = {id(e): ok for e, ok in results}
             for m, env in batch:
                 if env is None:
                     self.rejected_structural += 1
                     continue
-                _, ok = next(verdicts)
+                ok = verdicts.get(id(env), False)
                 # Monotonic-seqno replay guard: the tree delivers FIFO from a
                 # single root, so a valid stream is strictly increasing; a
                 # replayed (or cross-captured) envelope arrives late and out

@@ -231,11 +231,24 @@ def heartbeat_mesh(
     outbound: Optional[jax.Array] = None,  # bool[N, K] I dialed this edge
     do_opportunistic=False,  # bool scalar: opportunistic-graft tick
     og_threshold: float = 1.0,  # ScoreParams.opportunistic_graft_threshold
-) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    ignore_backoff: Optional[jax.Array] = None,  # bool[N] misbehaviour model
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """Mesh maintenance: prune negative-score and over-degree links, graft
     toward D from well-scored candidates, then symmetrize edge state.
 
-    Returns (new_mesh, grafted, pruned, new_backoff) as [N, K].
+    Returns (new_mesh, grafted, pruned, new_backoff, bo_violations); the
+    first four are [N, K], ``bo_violations`` is f32[N] — how many of each
+    peer's GRAFT attempts this heartbeat were refused because the edge sits
+    inside the remote's prune-backoff window.  The spec's P7 behaviour
+    penalty charges exactly these; the model feeds them into
+    ``GlobalCounters.behaviour_penalty``.
+
+    A spec-following peer never attempts such a graft (its own candidacy is
+    gated by the same — symmetric — backoff countdown), so honest rows are
+    always 0.  ``ignore_backoff`` (bool[N]) marks peers that graft through
+    their own backoff anyway — the knob attack traces use to model GRAFT
+    flooders; their attempts are refused on the remote side and counted
+    here.
 
     Desired-set rules (each side computes independently, then edges agree):
     - drop slots whose score < 0 or whose remote died;
@@ -292,9 +305,13 @@ def heartbeat_mesh(
         ob_short = jnp.clip(
             p.d_out - (chosen & outbound).sum(axis=1), 0, p.d_out
         ).astype(jnp.int32)
+        # Swap at most as many as we can drop back out: each outbound
+        # addition must displace a non-outbound random fill, or the kept set
+        # would exceed D (the swap is an exchange, not a top-up).
+        droppable = (fill & ~outbound).sum(axis=1).astype(jnp.int32)
         add_ob = top_mask(
             jnp.where(keep & outbound & ~chosen, noise, -jnp.inf),
-            ob_short,
+            jnp.minimum(ob_short, droppable),
             kmax=p.d_out,
         )
         n_added = add_ob.sum(axis=1).astype(jnp.int32)
@@ -312,7 +329,10 @@ def heartbeat_mesh(
     deg_now = keep.sum(axis=1)
     score_ok = scores >= 0.0
     bo_ok = backoff <= 0
-    cand = kmask & ~keep & score_ok & bo_ok
+    cand_bo = bo_ok if ignore_backoff is None else (
+        bo_ok | ignore_backoff[:, None]
+    )
+    cand = kmask & ~keep & score_ok & cand_bo
     r = jax.random.uniform(kgraft, (n, k))
     want_more = jnp.where(
         deg_now < p.d_lo, jnp.maximum(p.d - deg_now, 0), 0
@@ -386,4 +406,8 @@ def heartbeat_mesh(
         jnp.int32(p.prune_backoff_heartbeats),
         jnp.maximum(backoff - 1, 0),
     )
-    return new_mesh, grafted, pruned, new_backoff
+    # GRAFTs refused for landing inside the remote's backoff window — the
+    # P7-chargeable misbehaviour (zero for spec-following peers, whose own
+    # symmetric countdown gates candidacy).
+    bo_violations = (graft & ~bo_rev_ok).sum(axis=1).astype(jnp.float32)
+    return new_mesh, grafted, pruned, new_backoff, bo_violations

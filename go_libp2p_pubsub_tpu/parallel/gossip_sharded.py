@@ -35,6 +35,22 @@ from ..models.gossipsub import GossipState, GossipSub
 from .mesh import PEER_AXIS, make_mesh
 
 
+# Field-name classification of GossipState's sharding layout.  By NAME, not
+# by shape: ``shape[0] == n_peers`` would silently shard a message-window
+# array whenever msg_window happens to equal n_peers (and silently replicate
+# a peer array under a future field rename).  An unclassified field is an
+# error, so adding a GossipState field forces a sharding decision here.
+_PEER_DIM_FIELDS = frozenset({
+    "nbrs", "rev", "nbr_valid", "outbound", "alive", "subscribed",
+    "edge_live", "nbr_sub", "mesh", "fanout", "fanout_age", "backoff",
+    "counters", "gcounters", "scores", "have_w", "fresh_w",
+    "gossip_pend_w", "adv_w", "first_step",
+})
+_REPLICATED_FIELDS = frozenset({
+    "msg_valid", "msg_birth", "msg_active", "msg_used", "key", "step",
+})
+
+
 def gossip_state_shardings(
     st: GossipState, mesh: Mesh, n_peers: int, axis: str = PEER_AXIS
 ):
@@ -45,13 +61,29 @@ def gossip_state_shardings(
         raise ValueError(
             f"n_peers ({n_peers}) must divide by mesh axis size ({n_dev})"
         )
+    unclassified = set(st._fields) - _PEER_DIM_FIELDS - _REPLICATED_FIELDS
+    if unclassified:
+        raise ValueError(
+            f"GossipState fields without a sharding rule: "
+            f"{sorted(unclassified)}; classify them in gossip_sharded.py"
+        )
 
-    def one(x):
-        if getattr(x, "ndim", 0) >= 1 and x.shape[0] == n_peers:
-            return NamedSharding(mesh, P(axis, *([None] * (x.ndim - 1))))
-        return NamedSharding(mesh, P())
+    def shard_peer_leaf(x):
+        if getattr(x, "ndim", 0) < 1 or x.shape[0] != n_peers:
+            raise ValueError(
+                f"peer-dim leaf has shape {getattr(x, 'shape', None)}, "
+                f"expected leading dim {n_peers}"
+            )
+        return NamedSharding(mesh, P(axis, *([None] * (x.ndim - 1))))
 
-    return jax.tree.map(one, st)
+    repl = NamedSharding(mesh, P())
+    return type(st)(**{
+        name: jax.tree.map(
+            shard_peer_leaf if name in _PEER_DIM_FIELDS else lambda x: repl,
+            getattr(st, name),
+        )
+        for name in st._fields
+    })
 
 
 class ShardedGossipSub:

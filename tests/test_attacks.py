@@ -74,3 +74,30 @@ def test_eclipse_rotated_out_and_delivery_restored():
     st = gs.publish(st, jnp.int32(honest_src), jnp.int32(1), jnp.asarray(True))
     st = gs.run(st, 24)
     assert bool(gs.have_bool(st)[target, 1]), "eclipsed target must recover"
+
+
+def test_backoff_graft_spam_penalized_and_evicted():
+    """A peer that GRAFTs through its prune-backoff window accrues the P7
+    behaviour penalty: its score goes negative and its graft acceptance
+    collapses (VERDICT r2 item 5; spec's backoff-violation penalty)."""
+    from go_libp2p_pubsub_tpu.models.attacks import backoff_spam_attack
+
+    gs, st, report, attackers = backoff_spam_attack(
+        n_peers=64, n_attackers=6, n_rounds=8,
+        n_slots=16, conn_degree=8, msg_window=64,
+    )
+    pen = report["attacker_behaviour_penalty"]
+    assert pen[-1] > 0, "refused in-backoff grafts must charge P7"
+    assert report["attacker_global_score"][-1] < 0, (
+        "P7 must push the spammer's global score negative"
+    )
+    # Eviction holds at the end: backoff spam cannot re-enter the mesh.
+    edges = report["attacker_mesh_edges"]
+    assert edges[-1] <= edges.max() // 4 or edges[-1] == 0, (
+        f"graft spam kept attackers meshed: {edges.tolist()}"
+    )
+    # Honest peers never accrue P7.
+    honest_pen = np.asarray(st.gcounters.behaviour_penalty)[
+        ~np.asarray(attackers)
+    ]
+    assert (honest_pen == 0).all()

@@ -232,3 +232,16 @@ def test_pipeline_survives_malformed_envelope():
     out = dict((e.seqno, ok) for e, ok in pipe.flush())
     assert out == {0: True, 99: False, 1: True, 98: False, 2: True}
     assert pipe.stats["rejected"] == 2 and pipe.stats["accepted"] == 3
+
+
+def test_pipeline_drop_pending():
+    """drop_pending hands back queued envelopes and empties the queue, so a
+    caller that re-owns a failed batch cannot double-verify on retry."""
+    envs = [sign_envelope(os.urandom(32), "t", i, b"m") for i in range(3)]
+    pipe = ValidationPipeline(backend=_pipeline_backend(), flush_threshold=100)
+    for e in envs:
+        pipe.submit(e)
+    dropped = pipe.drop_pending()
+    assert dropped == envs
+    assert pipe.flush() == []          # nothing left to verify
+    assert pipe.stats["validated"] == 0

@@ -75,3 +75,41 @@ def test_pallas_flag_rejected():
             n_peers=256, n_devices=N_DEV, n_slots=16, conn_degree=8,
             use_pallas=True,
         )
+
+
+def test_msg_window_equal_to_peer_count_not_missharded():
+    """msg_window == n_peers must not shard the message-metadata arrays
+    (regression risk: shape-based classification keyed on shape[0] ==
+    n_peers; the layout is now declared per field name)."""
+    sg = ShardedGossipSub(
+        n_peers=16, n_devices=2, n_slots=8, conn_degree=4, msg_window=16
+    )
+    st = sg.init(seed=0)
+    assert st.msg_valid.sharding.spec == ()   # replicated, not peer-sharded
+    assert st.msg_birth.sharding.spec == ()
+    assert st.have_w.sharding.spec[0] == PEER_AXIS
+    st = sg.publish(st, jnp.asarray(0), jnp.asarray(0), jnp.asarray(True))
+    st = sg.run(st, 8)
+    assert int(st.step) == 8
+
+
+def test_unclassified_state_field_rejected():
+    """A GossipState field without a declared sharding rule is an error, not
+    a silent replicate/shard guess."""
+    from go_libp2p_pubsub_tpu.parallel import gossip_sharded as mod
+
+    class FakeState(mod.GossipState):
+        pass
+
+    sg = ShardedGossipSub(
+        n_peers=16, n_devices=2, n_slots=8, conn_degree=4, msg_window=8
+    )
+    st = sg.init(seed=0)
+    removed = mod._PEER_DIM_FIELDS - {"mesh"}
+    orig = mod._PEER_DIM_FIELDS
+    mod._PEER_DIM_FIELDS = removed
+    try:
+        with pytest.raises(ValueError, match="mesh"):
+            mod.gossip_state_shardings(st, sg.mesh, 16)
+    finally:
+        mod._PEER_DIM_FIELDS = orig

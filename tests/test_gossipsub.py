@@ -174,7 +174,7 @@ def test_oversubscription_keeps_dscore_best_plus_random_fill():
     alive = jnp.ones((n,), bool)
     picked = set()
     for seed in range(8):
-        new_mesh, _, _, _ = heartbeat_mesh(
+        new_mesh, _, _, _, _ = heartbeat_mesh(
             jax.random.PRNGKey(seed), mesh, scores, nbrs, rev, valid, alive, p
         )  # all peers alive: edge_live == valid
         kept = np.flatnonzero(np.asarray(new_mesh[0]))
@@ -203,3 +203,43 @@ def test_floodsub_stats_ignore_invalid_messages():
     assert np.isnan(float(frac[1])), "invalid message must not report delivery"
     assert np.isnan(float(frac[2])), "unused slot must not report delivery"
     assert float(p50) >= 0
+
+
+def test_publish_recycle_clears_stale_ihave(gs):
+    """Recycling a window slot must clear it from the pending IHAVE snapshot
+    too: a stale advertisement of the OLD message in the slot would become a
+    phantom IWANT delivery of the NEW message."""
+    st = gs.init(seed=11)
+    st = st._replace(adv_w=jnp.full_like(st.adv_w, 0xFFFFFFFF))
+    st = gs.publish(st, jnp.int32(0), jnp.int32(5), jnp.asarray(True))
+    adv = np.asarray(st.adv_w)
+    assert not (adv & (1 << 5)).any(), "slot 5 must be struck from adv_w"
+    assert (adv & (1 << 6)).all(), "other slots' advertisements untouched"
+
+
+def test_outbound_swap_never_exceeds_degree():
+    """The d_out oversubscription swap is an exchange, not a top-up: when
+    there are fewer droppable non-outbound fills than the outbound deficit,
+    the kept set must still shrink to D (regression: it exceeded D by up to
+    d_out)."""
+    import jax
+
+    from go_libp2p_pubsub_tpu.ops.gossip import heartbeat_mesh
+
+    n, k = 2, 16
+    # d_score close to d leaves a 1-slot random fill; with every non-best
+    # slot outbound the droppable set can be empty while the quota is short.
+    p = GossipSubParams(d=6, d_lo=4, d_hi=8, d_score=5, d_out=2)
+    nbrs = jnp.zeros((n, k), jnp.int32).at[1].set(0)
+    rev = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32), (n, k))
+    valid = jnp.ones((n, k), bool)
+    mesh = jnp.ones((n, k), bool)
+    scores = jnp.broadcast_to(jnp.arange(k, dtype=jnp.float32), (n, k))
+    alive = jnp.ones((n,), bool)
+    outbound = jnp.broadcast_to(jnp.arange(k) < 11, (n, k))  # best 5 inbound
+    for seed in range(8):
+        new_mesh, _, _, _, _ = heartbeat_mesh(
+            jax.random.PRNGKey(seed), mesh, scores, nbrs, rev, valid, alive,
+            p, outbound=outbound,
+        )
+        assert int(np.asarray(new_mesh[0]).sum()) <= p.d

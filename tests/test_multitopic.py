@@ -113,3 +113,34 @@ def test_multitopic_matches_singletopic_delivery():
     fg, p50g, _ = g.delivery_stats(sg)
     assert float(np.asarray(fm)[0, 0]) == 1.0
     assert float(np.asarray(fg)[0]) == 1.0
+
+
+def test_publish_advances_topic_key():
+    """Back-to-back publishes to one topic within a step must draw fresh
+    randomness (regression: fold_in(key, step) reused identical draws for
+    fanout top-up until the key advanced at the next heartbeat)."""
+    mt = MultiTopicGossipSub(
+        n_topics=2, n_peers=32, n_slots=8, conn_degree=4, msg_window=8
+    )
+    st = mt.init(seed=0)
+    k_before = np.asarray(st.keys).copy()
+    st = mt.publish(st, jnp.int32(1), jnp.int32(0), jnp.int32(0), jnp.asarray(True))
+    k_after = np.asarray(st.keys)
+    assert not np.array_equal(k_before[1], k_after[1]), "published topic key must advance"
+    np.testing.assert_array_equal(k_before[0], k_after[0])
+
+
+def test_publish_recycle_clears_stale_ihave_multitopic():
+    """Recycling a window slot clears pending IHAVE snapshots for that slot
+    in the published topic (stale advertisements would turn into phantom
+    IWANT deliveries of the NEW message)."""
+    mt = MultiTopicGossipSub(
+        n_topics=2, n_peers=32, n_slots=8, conn_degree=4, msg_window=8
+    )
+    st = mt.init(seed=0)
+    full = jnp.full_like(st.adv_w, 0xFFFFFFFF)
+    st = st._replace(adv_w=full)
+    st = mt.publish(st, jnp.int32(0), jnp.int32(0), jnp.int32(3), jnp.asarray(True))
+    adv = np.asarray(st.adv_w)
+    assert not (adv[0] & (1 << 3)).any(), "slot 3 IHAVEs must be struck in topic 0"
+    assert (adv[1] & (1 << 3)).all(), "other topics' snapshots untouched"
