@@ -121,6 +121,8 @@ class GossipSubParams:
     opportunistic_graft_peers: int = 2
     opportunistic_graft_ticks: int = 8  # heartbeats between opportunistic checks
     max_ihave_length: int = 5000
+    max_iwant_length: int = 5000  # per-advertiser ask budget per heartbeat
+    #                               (go-gossipsub reuses MaxIHaveLength here)
     seen_ttl_s: float = 120.0
     prune_backoff_heartbeats: int = 4  # spec's PruneBackoff, in heartbeats
     flood_publish: bool = True  # own publishes go to ALL topic peers above
@@ -141,6 +143,8 @@ class GossipSubParams:
             raise ValueError("prune_backoff_heartbeats must be >= 0")
         if self.opportunistic_graft_ticks < 1:
             raise ValueError("opportunistic_graft_ticks must be >= 1")
+        if self.max_iwant_length < 1:
+            raise ValueError("max_iwant_length must be >= 1")
 
 
 @dataclass(frozen=True)

@@ -66,9 +66,14 @@ class GossipState(NamedTuple):
     scores: jax.Array       # f32[N, K] cached neighbor scores (last heartbeat)
     have_w: jax.Array       # u32[N, W] possession (seen-cache within window)
     fresh_w: jax.Array      # u32[N, W] first-received last round
-    gossip_pend_w: jax.Array  # u32[N, W] IWANT deliveries due next round
-    adv_w: jax.Array        # u32[N, K, W] IHAVEs received at the last
-                            # heartbeat, awaiting the IWANT round
+    gossip_pend_w: jax.Array  # u32[N, W] offers/transfers landing next round
+    iwant_pend_w: jax.Array   # u32[N, W] IWANT transfers granted at the last
+                              # heartbeat, landing in two rounds (the IHAVE ->
+                              # IWANT -> transfer wire hops); moves into
+                              # gossip_pend_w at the next propagate
+    gossip_mute: jax.Array  # bool[N] peers that advertise but never serve
+                            # IWANTs (promise-breaking adversary model; their
+                            # refusals charge P7)
     first_step: jax.Array   # i32[N, M] first-receipt step, -1 = never
     msg_valid: jax.Array    # bool[M] validation verdict
     msg_birth: jax.Array    # i32[M] publish step
@@ -191,7 +196,7 @@ def compute_edge_live(
 
 
 def seed_message(
-    have_w, fresh_w, gossip_pend_w, adv_w, first_step,
+    have_w, fresh_w, gossip_pend_w, iwant_pend_w, first_step,
     msg_valid, msg_birth, msg_active, msg_used,
     src, slot, valid, step, w,
 ):
@@ -199,10 +204,11 @@ def seed_message(
     models: clear the slot's bits for ALL peers (slot reuse), then stamp the
     publisher.  Returns the nine updated window leaves in argument order.
 
-    ``adv_w`` (the IHAVE snapshot awaiting its IWANT round) must be cleared
-    too: a stale advertisement for the OLD message in a recycled slot would
-    otherwise turn into a phantom IWANT delivery of the NEW message — peers
-    would record first receipts for bytes they never received.
+    Both pend planes (``gossip_pend_w`` and the heartbeat-granted
+    ``iwant_pend_w``) must be cleared too: a stale pending transfer of the
+    OLD message in a recycled slot would otherwise turn into a phantom
+    delivery of the NEW message — peers would record first receipts for
+    bytes they never received.
     """
     bm = bitpack.bit_mask(slot, w)               # u32[W] one-hot
     have_w = have_w & ~bm
@@ -211,7 +217,7 @@ def seed_message(
         have_w.at[src].set(have_w[src] | bm),
         fresh_w.at[src].set(fresh_w[src] | bm),
         gossip_pend_w & ~bm,
-        adv_w & ~bm[None, None, :],
+        iwant_pend_w & ~bm,
         first_step.at[:, slot].set(-1).at[src, slot].set(step),
         msg_valid.at[slot].set(valid),
         msg_birth.at[slot].set(step),
@@ -315,7 +321,8 @@ class GossipSub:
             have_w=jnp.zeros((n, w), jnp.uint32),
             fresh_w=jnp.zeros((n, w), jnp.uint32),
             gossip_pend_w=jnp.zeros((n, w), jnp.uint32),
-            adv_w=jnp.zeros((n, k, w), jnp.uint32),
+            iwant_pend_w=jnp.zeros((n, w), jnp.uint32),
+            gossip_mute=jnp.zeros((n,), bool),
             first_step=jnp.full((n, m), -1, jnp.int32),
             msg_valid=jnp.zeros((m,), bool),
             msg_birth=jnp.zeros((m,), jnp.int32),
@@ -370,11 +377,11 @@ class GossipSub:
         """
         p, sp = self.params, self.score_params
         n, k = self.n, self.k
-        (have_w, fresh_w, pend_w, adv_w, first_step,
+        (have_w, fresh_w, pend_w, iwant_pend_w, first_step,
          mv, mb, ma, mu) = seed_message(
-            st.have_w, st.fresh_w, st.gossip_pend_w, st.adv_w, st.first_step,
-            st.msg_valid, st.msg_birth, st.msg_active, st.msg_used,
-            src, slot, valid, st.step, self.w,
+            st.have_w, st.fresh_w, st.gossip_pend_w, st.iwant_pend_w,
+            st.first_step, st.msg_valid, st.msg_birth, st.msg_active,
+            st.msg_used, src, slot, valid, st.step, self.w,
         )
         kpub, knext = jax.random.split(st.key)
         scores_src = st.scores[src]                              # f32[K]
@@ -414,8 +421,8 @@ class GossipSub:
         pend_w = pend_w.at[rows].set(upd, mode="drop")
         return st._replace(
             have_w=have_w, fresh_w=fresh_w, gossip_pend_w=pend_w,
-            adv_w=adv_w, first_step=first_step, msg_valid=mv, msg_birth=mb,
-            msg_active=ma, msg_used=mu, fanout=fanout,
+            iwant_pend_w=iwant_pend_w, first_step=first_step, msg_valid=mv,
+            msg_birth=mb, msg_active=ma, msg_used=mu, fanout=fanout,
             fanout_age=fanout_age, key=knext,
         )
 
@@ -428,6 +435,15 @@ class GossipSub:
             alive=alive,
             edge_live=compute_edge_live(st.nbr_valid, st.nbrs, alive),
         )
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def set_gossip_mute(self, st: GossipState, mask: jax.Array) -> GossipState:
+        """Mark peers (bool[N]) as promise-breakers: they keep advertising
+        IHAVEs but never serve the resulting IWANTs.  Every ask directed at
+        them is counted as a broken promise and charged to their P7
+        behaviour penalty at the heartbeat — the adversary model of the
+        gossip-spam attack trace (the spec's gossip promise tracking)."""
+        return st._replace(gossip_mute=mask)
 
     @functools.partial(jax.jit, static_argnums=0)
     def set_subscribed(self, st: GossipState, sub: jax.Array) -> GossipState:
@@ -497,11 +513,29 @@ class GossipSub:
             lambda: (st.edge_live, st.nbr_sub),
         )
 
-        # IHAVE phase of the two-round gossip exchange: advertisements are
-        # recorded per receiving slot; the IWANT and transfer happen on the
-        # next two propagate rounds.  Advertisable window = valid, in-mcache,
-        # and within the last history_gossip heartbeats (the spec's gossip
-        # window is narrower than the retention window).
+        # Seen-cache TTL (applied to have_w below, and to the IWANT dedup so
+        # the grant matches what the next round would have computed):
+        # receipts older than seen_ttl_s fall out of the dedup window
+        # (first_step keeps the delivery record for metrics).
+        seen_ttl_steps = (
+            max(1, round(p.seen_ttl_s / p.heartbeat_interval_s))
+            * self.heartbeat_steps
+        )
+        seen_expired = st.msg_used & (st.step - st.msg_birth > seen_ttl_steps)
+        have_w = st.have_w & ~bitpack.pack(seen_expired)
+
+        # Two-phase IHAVE/IWANT, collapsed at the heartbeat: advertisements
+        # are computed per receiving slot, each receiver immediately selects
+        # its IWANT asks (one first-advertising slot per wanted id, capped
+        # per advertiser), and the granted transfers land TWO propagate
+        # rounds later via ``iwant_pend_w`` -> ``gossip_pend_w`` — the same
+        # arrival round as the wire's IHAVE -> IWANT -> transfer hops.  The
+        # [N, K, W] advertisement cube is TRANSIENT here (never carried in
+        # state): at 100k peers it is ~51 MB that the r3 design read and
+        # re-zeroed on every propagate round.  Deviation vs computing the
+        # IWANT on the next round: offers folded between heartbeat and next
+        # round (a publish racing the heartbeat) are not deduped against —
+        # the same race an IWANT on the wire loses.
         gossip_age_ok = (
             st.step - st.msg_birth <= p.history_gossip * self.heartbeat_steps
         )
@@ -511,6 +545,21 @@ class GossipSub:
             edge_live & nbr_sub, part, scores, gossip_w, p,
             sp.gossip_threshold,
         )
+        # An advertiser serves unless it is a promise-breaker (gossip_mute)
+        # — death is already excluded by edge_live in the selection.
+        serve_ok = ~safe_gather(st.gossip_mute, px.nbrs, True)
+        iwant_pend_w, broken = gossip_ops.iwant_select_packed(
+            adv_w, have_w, edge_live & nbr_sub, serve_ok, part,
+            p.max_iwant_length,
+        )
+        # P7: broken promises charge the ADVERTISER (indexed by remote id).
+        promise_ids = jnp.where(
+            px.nbr_valid, px.nbrs, self.n
+        ).reshape(-1)
+        promise_viol = jax.ops.segment_sum(
+            broken.reshape(-1), promise_ids, num_segments=self.n + 1
+        )[: self.n]
+        g = g._replace(behaviour_penalty=g.behaviour_penalty + promise_viol)
 
         # Fanout maintenance for non-subscribed publishers: age out after
         # fanout_ttl_s of publish silence; drop dead/below-threshold peers;
@@ -538,15 +587,9 @@ class GossipSub:
         )
         fanout = jnp.where(factive[:, None], fkeep | fadd, False)
 
-        # Seen-cache TTL: receipts older than seen_ttl_s fall out of the
-        # dedup window (first_step keeps the delivery record for metrics).
-        seen_ttl_steps = (
-            max(1, round(p.seen_ttl_s / p.heartbeat_interval_s))
-            * self.heartbeat_steps
-        )
-        seen_expired = st.msg_used & (st.step - st.msg_birth > seen_ttl_steps)
-
-        # Expire messages out of the mcache history window.
+        # Expire messages out of the mcache history window.  (iwant_pend_w
+        # needs no strike: the grant was gated by gossip_age_ok, which is
+        # strictly narrower than the history window.)
         expired = st.msg_active & (
             st.step - st.msg_birth > p.history_length * self.heartbeat_steps
         )
@@ -565,15 +608,15 @@ class GossipSub:
             counters=c,
             gcounters=g,
             scores=scores,
-            have_w=st.have_w & ~bitpack.pack(seen_expired),
+            have_w=have_w,
             gossip_pend_w=st.gossip_pend_w & ~dead_w[None, :],
-            adv_w=adv_w & ~dead_w[None, None, :],
+            iwant_pend_w=iwant_pend_w,
             msg_active=st.msg_active & ~expired,
             key=knext,
         )
 
     def _propagate(self, st: GossipState) -> GossipState:
-        # Fold due gossip/flood deliveries (requested or offered last round)
+        # Fold due gossip/flood deliveries (granted or offered last round)
         # into this round's receipts.  These copies arrive this round and
         # relay NEXT round (they join fresh_w after the eager push below) —
         # merging them into the relayed set here would move a message two
@@ -583,18 +626,6 @@ class GossipSub:
             st.gossip_pend_w & ~st.have_w & gossip_ops._as_mask(st.alive)[:, None]
         )
         have_w = st.have_w | gossip_new
-        first_step = jnp.where(
-            bitpack.unpack(gossip_new, self.m) & (st.first_step < 0),
-            st.step,
-            st.first_step,
-        )
-
-        # IWANT phase: turn last heartbeat's IHAVE snapshot into pull
-        # requests for what we still lack; the transfer lands next round via
-        # the fold above (two wire hops after the IHAVE, as on the wire).
-        pend_next = gossip_ops.iwant_requests_packed(
-            st.adv_w, have_w, st.edge_live, st.alive
-        )
 
         # Eager push over the mesh, graylist-gated receiver-side: frames
         # from neighbors scored below graylist_threshold are ignored
@@ -616,10 +647,13 @@ class GossipSub:
                 relay_mesh, st.nbrs, st.edge_live, st.alive, have_w,
                 st.fresh_w, valid_w,
             )
+        # One [N, M] stamping pass for both receipt sources (pend fold +
+        # eager push): both record the same step, so the union stamps once.
         first_step = jnp.where(
-            bitpack.unpack(out.new_w, self.m) & (first_step < 0),
+            bitpack.unpack(gossip_new | out.new_w, self.m)
+            & (st.first_step < 0),
             st.step,
-            first_step,
+            st.first_step,
         )
         c = st.counters._replace(
             first_message_deliveries=st.counters.first_message_deliveries
@@ -635,8 +669,10 @@ class GossipSub:
             fresh_w=out.fresh_w | gossip_new,
             first_step=first_step,
             counters=c,
-            gossip_pend_w=pend_next,
-            adv_w=jnp.zeros_like(st.adv_w),
+            # The heartbeat's granted IWANT transfers become next round's
+            # pend fold — the second wire hop of the gossip exchange.
+            gossip_pend_w=st.iwant_pend_w,
+            iwant_pend_w=jnp.zeros_like(st.iwant_pend_w),
         )
 
     @functools.partial(jax.jit, static_argnums=0)

@@ -63,7 +63,9 @@ class MultiTopicState(NamedTuple):
     have_w: jax.Array        # u32[T, N, W]
     fresh_w: jax.Array       # u32[T, N, W]
     gossip_pend_w: jax.Array # u32[T, N, W]
-    adv_w: jax.Array         # u32[T, N, K, W] IHAVEs awaiting IWANT
+    iwant_pend_w: jax.Array  # u32[T, N, W] heartbeat-granted IWANT transfers
+    gossip_mute: jax.Array   # bool[N] promise-breakers (shared: an attacker
+                             # that never serves IWANTs is mute in every topic)
     first_step: jax.Array    # i32[T, N, M]
     msg_valid: jax.Array     # bool[T, M]
     msg_birth: jax.Array     # i32[T, M]
@@ -143,7 +145,8 @@ class MultiTopicGossipSub:
             have_w=jnp.zeros((t, n, w), jnp.uint32),
             fresh_w=jnp.zeros((t, n, w), jnp.uint32),
             gossip_pend_w=jnp.zeros((t, n, w), jnp.uint32),
-            adv_w=jnp.zeros((t, n, k, w), jnp.uint32),
+            iwant_pend_w=jnp.zeros((t, n, w), jnp.uint32),
+            gossip_mute=jnp.zeros((n,), bool),
             first_step=jnp.full((t, n, m), -1, jnp.int32),
             msg_valid=jnp.zeros((t, m), bool),
             msg_birth=jnp.zeros((t, m), jnp.int32),
@@ -181,9 +184,9 @@ class MultiTopicGossipSub:
 
         p, sp = self.params, self.score_params
         n, k = self.n, self.k
-        (have_t, fresh_t, pend_t, adv_t, fs_t, mv, mb, ma, mu) = seed_message(
+        (have_t, fresh_t, pend_t, iwant_t, fs_t, mv, mb, ma, mu) = seed_message(
             st.have_w[topic], st.fresh_w[topic], st.gossip_pend_w[topic],
-            st.adv_w[topic], st.first_step[topic], st.msg_valid[topic],
+            st.iwant_pend_w[topic], st.first_step[topic], st.msg_valid[topic],
             st.msg_birth[topic], st.msg_active[topic], st.msg_used[topic],
             src, slot, valid, st.step, self.w,
         )
@@ -226,7 +229,7 @@ class MultiTopicGossipSub:
             have_w=st.have_w.at[topic].set(have_t),
             fresh_w=st.fresh_w.at[topic].set(fresh_t),
             gossip_pend_w=st.gossip_pend_w.at[topic].set(pend_t),
-            adv_w=st.adv_w.at[topic].set(adv_t),
+            iwant_pend_w=st.iwant_pend_w.at[topic].set(iwant_t),
             first_step=st.first_step.at[topic].set(fs_t),
             msg_valid=st.msg_valid.at[topic].set(mv),
             msg_birth=st.msg_birth.at[topic].set(mb),
@@ -236,6 +239,14 @@ class MultiTopicGossipSub:
             fanout_age=fanout_age,
             keys=st.keys.at[topic].set(knext),
         )
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def set_gossip_mute(
+        self, st: MultiTopicState, mask: jax.Array
+    ) -> MultiTopicState:
+        """Mark peers (bool[N]) as gossip promise-breakers in every topic
+        (see ``GossipSub.set_gossip_mute``)."""
+        return st._replace(gossip_mute=mask)
 
     @functools.partial(jax.jit, static_argnums=0)
     def kill_peers(self, st: MultiTopicState, mask: jax.Array) -> MultiTopicState:
@@ -263,30 +274,31 @@ class MultiTopicGossipSub:
                                 jnp.int32)
 
         def one(mesh, fanout, backoff, counters, have_w, fresh_w, pend_w,
-                adv_w, first_step, mv, mb, ma, mu, key, al, el, sub):
+                iwant_w, first_step, mv, mb, ma, mu, key, al, el, sub):
             g = GossipState(
                 nbrs=st.nbrs, rev=st.rev, nbr_valid=st.nbr_valid,
                 outbound=st.outbound, alive=al, subscribed=sub,
                 edge_live=el, nbr_sub=ones_nk, mesh=mesh, fanout=fanout,
                 fanout_age=inactive_age, backoff=backoff, counters=counters,
                 gcounters=st.gcounters, scores=st.scores, have_w=have_w,
-                fresh_w=fresh_w, gossip_pend_w=pend_w, adv_w=adv_w,
-                first_step=first_step, msg_valid=mv, msg_birth=mb,
-                msg_active=ma, msg_used=mu, key=key, step=st.step,
+                fresh_w=fresh_w, gossip_pend_w=pend_w, iwant_pend_w=iwant_w,
+                gossip_mute=st.gossip_mute, first_step=first_step,
+                msg_valid=mv, msg_birth=mb, msg_active=ma, msg_used=mu,
+                key=key, step=st.step,
             )
             o = gs._propagate(g)
             return (o.counters, o.have_w, o.fresh_w, o.gossip_pend_w,
-                    o.adv_w, o.first_step)
+                    o.iwant_pend_w, o.first_step)
 
-        counters, have_w, fresh_w, pend_w, adv_w, first_step = jax.vmap(one)(
+        counters, have_w, fresh_w, pend_w, iwant_w, first_step = jax.vmap(one)(
             st.mesh, st.fanout, st.backoff, st.counters, st.have_w,
-            st.fresh_w, st.gossip_pend_w, st.adv_w, st.first_step,
+            st.fresh_w, st.gossip_pend_w, st.iwant_pend_w, st.first_step,
             st.msg_valid, st.msg_birth, st.msg_active, st.msg_used, st.keys,
             self._topic_alive(st), st.edge_live, st.subscribed,
         )
         return st._replace(
             counters=counters, have_w=have_w, fresh_w=fresh_w,
-            gossip_pend_w=pend_w, adv_w=adv_w, first_step=first_step,
+            gossip_pend_w=pend_w, iwant_pend_w=iwant_w, first_step=first_step,
         )
 
     def _heartbeat(self, st: MultiTopicState) -> MultiTopicState:
@@ -320,6 +332,12 @@ class MultiTopicGossipSub:
             * self.heartbeat_steps
         )
 
+        # Promise-breaker view of each slot's remote — topology is shared, so
+        # one gather serves every topic.
+        from ..ops.graphs import safe_gather as _safe_gather
+
+        serve_ok = ~_safe_gather(st.gossip_mute, st.nbrs, True)
+
         def one(mesh_t, fan_t, fage_t, bo_t, c_t, have_t, pend_t, mv, ma,
                 mbirth, mused, k4, al, el, sub_t):
             khb, kgossip, kfan, knext = k4
@@ -335,12 +353,19 @@ class MultiTopicGossipSub:
             # PX is not run per topic: it rewires the SHARED connection
             # layer, and T topics racing scatter-writes into one adjacency
             # would break the slot pairing.  (Single-topic model runs it.)
+            seen_expired = mused & (st.step - mbirth > seen_ttl_steps)
+            have2 = have_t & ~bitpack.pack(seen_expired)
             gossip_age_ok = (
                 st.step - mbirth <= p.history_gossip * self.heartbeat_steps
             )
             adv = gossip_ops.ihave_advertise_packed(
                 kgossip, have_t, new_mesh, st.nbrs, st.rev, el, al, scores,
                 bitpack.pack(mv & ma & gossip_age_ok), p, sp.gossip_threshold,
+            )
+            # IWANT grant + promise accounting (see the single-topic
+            # heartbeat): transfers land two rounds out via iwant_pend_w.
+            iwant_t, broken_t = gossip_ops.iwant_select_packed(
+                adv, have2, el, serve_ok, al, p.max_iwant_length
             )
             # Fanout upkeep for this topic's non-subscribed publishers.
             fage2 = jnp.minimum(fage_t + 1, jnp.iinfo(jnp.int32).max // 2)
@@ -362,34 +387,42 @@ class MultiTopicGossipSub:
             )
             fan2 = jnp.where(factive[:, None], fkeep | fadd, False)
 
-            seen_expired = mused & (st.step - mbirth > seen_ttl_steps)
             expired = ma & (
                 st.step - mbirth > p.history_length * self.heartbeat_steps
             )
             dead_w = bitpack.pack(expired)
             return (
                 new_mesh, fan2, fage2, bo2, c2,
-                have_t & ~bitpack.pack(seen_expired),
+                have2,
                 pend_t & ~dead_w[None, :],
-                adv & ~dead_w[None, None, :],
-                ma & ~expired, knext, bo_viol,
+                iwant_t,
+                ma & ~expired, knext, bo_viol, broken_t,
             )
 
-        (mesh, fanout, fanout_age, backoff, c, have_w, pend, adv_w, mactive,
-         keys, bo_viols) = jax.vmap(one)(
+        (mesh, fanout, fanout_age, backoff, c, have_w, pend, iwant_w, mactive,
+         keys, bo_viols, broken) = jax.vmap(one)(
             st.mesh, st.fanout, st.fanout_age, st.backoff, c, st.have_w,
             st.gossip_pend_w, st.msg_valid, st.msg_active, st.msg_birth,
             st.msg_used, keys4, topic_alive, st.edge_live, st.subscribed,
         )
-        # P7 is a GLOBAL component: backoff-violating GRAFTs in any topic
-        # accrue to the sender's one behaviour-penalty counter.
+        # P7 is a GLOBAL component: backoff-violating GRAFTs and broken
+        # gossip promises in ANY topic accrue to the sender's one
+        # behaviour-penalty counter (broken is charged by REMOTE id).
+        promise_ids = jnp.where(st.nbr_valid, st.nbrs, self.n).reshape(-1)
+        promise_viol = jax.ops.segment_sum(
+            broken.sum(axis=0).reshape(-1), promise_ids,
+            num_segments=self.n + 1,
+        )[: self.n]
         g = g._replace(
-            behaviour_penalty=g.behaviour_penalty + bo_viols.sum(axis=0)
+            behaviour_penalty=g.behaviour_penalty
+            + bo_viols.sum(axis=0)
+            + promise_viol
         )
         return st._replace(
             mesh=mesh, fanout=fanout, fanout_age=fanout_age, backoff=backoff,
             counters=c, gcounters=g, scores=scores, have_w=have_w,
-            gossip_pend_w=pend, adv_w=adv_w, msg_active=mactive, keys=keys,
+            gossip_pend_w=pend, iwant_pend_w=iwant_w, msg_active=mactive,
+            keys=keys,
         )
 
     @functools.partial(jax.jit, static_argnums=0)

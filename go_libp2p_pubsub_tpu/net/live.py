@@ -378,7 +378,14 @@ class _TreeNode:
         if hops > MAX_JOIN_HOPS:
             raise StreamClosed("join walk exceeded max hops")
         last_err: Optional[Exception] = None
-        for cand in welcome.peers:
+        candidates = welcome.peers
+        if self.host.peerstore.validate_ids:
+            # translPeerIDs boundary (subtree.go:228-239): drop malformed
+            # base58 ids from the wire-carried candidate list before dialing.
+            from ..utils.base58 import transl_peer_ids
+
+            candidates = transl_peer_ids(candidates)
+        for cand in candidates:
             if cand == s.remote_peer:
                 return s  # the sender admitted me: reuse this stream
             try:
@@ -652,8 +659,12 @@ class LiveNetwork:
     """Sync facade over the live plane for tests/tools: one event loop on a
     daemon thread; the API mirrors the sim plane's ``SimNetwork``."""
 
-    def __init__(self, repair_timeout_s: float = SUB_REPAIR_TIMEOUT_S):
-        self.peerstore = Peerstore()
+    def __init__(
+        self,
+        repair_timeout_s: float = SUB_REPAIR_TIMEOUT_S,
+        validate_ids: bool = False,
+    ):
+        self.peerstore = Peerstore(validate_ids=validate_ids)
         self.repair_timeout_s = repair_timeout_s
         self._loop = asyncio.new_event_loop()
         self._thread = threading.Thread(target=self._loop.run_forever, daemon=True)
@@ -664,7 +675,16 @@ class LiveNetwork:
         return asyncio.run_coroutine_threadsafe(coro, self._loop).result(timeout)
 
     def host(self) -> "SyncHost":
-        peer_id = f"livepeer-{self._counter}"
+        if self.peerstore.validate_ids:
+            # Real base58 ids (identity-multihash form) derived from the
+            # host counter — the regime the reference operates in.
+            from ..utils.base58 import peer_id_from_ed25519_pub
+
+            peer_id = peer_id_from_ed25519_pub(
+                self._counter.to_bytes(32, "big")
+            )
+        else:
+            peer_id = f"livepeer-{self._counter}"
         self._counter += 1
         h = LiveHost(peer_id, self.peerstore)
         self.call(h.start())

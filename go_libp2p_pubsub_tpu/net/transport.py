@@ -52,10 +52,20 @@ class Peerstore:
     the same precondition.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, validate_ids: bool = False) -> None:
+        # ``validate_ids=True`` is the reference's regime: peer ids must be
+        # well-formed base58 multihashes (``translPeerIDs``,
+        # ``subtree.go:228-239``) and wire-carried candidate lists are
+        # filtered through ``utils.base58.transl_peer_ids`` before dialing.
+        # The default keeps ids opaque strings (sim/test convenience).
         self._addrs: Dict[str, Tuple[str, int]] = {}
+        self.validate_ids = validate_ids
 
     def add(self, peer_id: str, host: str, port: int) -> None:
+        if self.validate_ids:
+            from ..utils.base58 import parse_peer_id
+
+            parse_peer_id(peer_id)  # raises ValueError on malformed ids
         self._addrs[peer_id] = (host, port)
 
     def addr(self, peer_id: str) -> Tuple[str, int]:
@@ -250,6 +260,14 @@ class LiveHost:
             line = await reader.readline()
             hs = json.loads(line)
             protoid, remote = hs["proto"], hs["peer"]
+            if self.peerstore.validate_ids:
+                # Strict-id regime: the accept boundary is where adversarial
+                # ids arrive; a malformed claimed id would be admitted as a
+                # child but unreachable via redirects (validating joiners
+                # filter it from candidate lists) — refuse it outright.
+                from ..utils.base58 import parse_peer_id
+
+                parse_peer_id(remote)
         except Exception:
             writer.close()
             return

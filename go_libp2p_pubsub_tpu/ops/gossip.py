@@ -190,21 +190,41 @@ def ihave_advertise(
     return cap_ihave(adv, p.max_ihave_length)
 
 
-def iwant_requests(
-    adv: jax.Array,        # bool[N, K, M] advertisements received last heartbeat
+def iwant_select(
+    adv: jax.Array,        # bool[N, K, M] advertisements received this heartbeat
     have: jax.Array,       # bool[N, M]
     edge_live: jax.Array,  # bool[N, K]
+    serve_ok: jax.Array,   # bool[N, K] the advertiser will actually serve
     alive: jax.Array,      # bool[N]
-) -> jax.Array:
-    """IWANT phase -> pending bool[N, M]: what each peer pulls from its
-    advertisers (offered ids it still lacks, over edges still live).
+    max_iwant_length: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """IWANT phase with promise accounting -> (pend bool[N, M],
+    broken f32[N, K]).
 
-    Unpacked reference for ``gossip_packed.iwant_requests_packed``; the
-    transfer lands next round via the model's pend fold — two wire hops
-    after the IHAVE, as on the wire.
+    Each peer asks ONE advertiser per wanted message — the first advertising
+    slot (go-gossipsub samples one peer per id; first-slot is the array
+    form), capped at ``max_iwant_length`` ids per advertiser per heartbeat
+    (go's MaxIHaveLength ask budget, word-granular like ``cap_ihave``).
+
+    ``pend`` is what actually arrives (advertisers with ``serve_ok`` false —
+    muted/dead — never serve); ``broken`` counts each slot's broken
+    promises, charged to the remote peer as P7 behaviour penalty by the
+    caller.  The wire protocol detects a broken promise after the IWANT
+    followup timeout; the lockstep model collapses that to the same
+    heartbeat (service is deterministic in-model) — a documented deviation.
+
+    Unpacked reference for ``gossip_packed.iwant_select_packed``.
     """
     want = adv & ~have[:, None, :] & edge_live[:, :, None]
-    return want.any(axis=1) & alive[:, None]
+    prefix = jnp.cumsum(want.astype(jnp.int32), axis=1)
+    first = want & (prefix == 1)                       # one advertiser per id
+    asked = cap_ihave(first, max_iwant_length)
+    served = asked & serve_ok[:, :, None]
+    pend = served.any(axis=1) & alive[:, None]
+    broken = (
+        (asked & ~serve_ok[:, :, None]).sum(axis=2).astype(jnp.float32)
+    )
+    return pend, broken
 
 
 def masked_median(vals: jax.Array, mask: jax.Array) -> jax.Array:
@@ -351,18 +371,27 @@ def heartbeat_mesh(
         )
 
     # Opportunistic grafting (v1.1): median kept-mesh score below the
-    # threshold -> graft above-median candidates.
+    # threshold -> graft above-median candidates.  The whole branch (a full
+    # [N, K] sort for the median + a top-k chain) runs under ``lax.cond`` so
+    # the 7-of-8 non-opportunistic heartbeats skip it entirely.
     if p.opportunistic_graft_peers > 0:
-        med = masked_median(scores, keep)
-        og_on = jnp.asarray(do_opportunistic) & (med < og_threshold)
-        og_want = jnp.where(og_on, p.opportunistic_graft_peers, 0).astype(
-            jnp.int32
-        )
-        rog = jax.random.uniform(kog, (n, k))
-        graft = graft | top_mask(
-            jnp.where(cand & ~graft & (scores > med[:, None]), rog, -jnp.inf),
-            og_want,
-            kmax=p.opportunistic_graft_peers,
+
+        def _with_og():
+            med = masked_median(scores, keep)
+            og_want = jnp.where(
+                med < og_threshold, p.opportunistic_graft_peers, 0
+            ).astype(jnp.int32)
+            rog = jax.random.uniform(kog, (n, k))
+            return graft | top_mask(
+                jnp.where(
+                    cand & ~graft & (scores > med[:, None]), rog, -jnp.inf
+                ),
+                og_want,
+                kmax=p.opportunistic_graft_peers,
+            )
+
+        graft = jax.lax.cond(
+            jnp.asarray(do_opportunistic), _with_og, lambda: graft
         )
 
     # Edge agreement via the reverse index.  For my slot (i, k) pointing at

@@ -9,7 +9,7 @@ the message window packed into uint32 words (``ops/bitpack.py``):
   delivery counting is ``lax.population_count``, and first-delivering-slot
   attribution is an exclusive cumulative-OR over the slot axis
   (Hillis–Steele, log2 K steps — no serial scan).
-- ``ihave_advertise_packed`` / ``iwant_requests_packed`` — the two-phase
+- ``ihave_advertise_packed`` / ``iwant_select_packed`` — the two-phase
   heartbeat IHAVE/IWANT.  Reformulated from a scatter-add into a
   **reverse-index gather**: a gossip target is always a slot-paired
   neighbor, so "peers push to chosen targets" is equivalently "each peer
@@ -163,19 +163,34 @@ def ihave_advertise_packed(
     return cap_ihave_packed(adv, p.max_ihave_length)
 
 
-def iwant_requests_packed(
-    adv_w: jax.Array,      # u32[N, K, W] advertisements received last heartbeat
+def iwant_select_packed(
+    adv_w: jax.Array,      # u32[N, K, W] advertisements received this heartbeat
     have_w: jax.Array,     # u32[N, W]
     edge_live: jax.Array,  # bool[N, K]
+    serve_ok: jax.Array,   # bool[N, K] the advertiser will actually serve
     alive: jax.Array,      # bool[N]
-) -> jax.Array:
-    """IWANT phase -> pending u32[N, W]: what each peer pulls from its
-    advertisers (messages offered that it still lacks, over edges still
-    live).  The transfer lands next round via the caller's pend fold — the
-    advertiser's mcache retention (``history_length > history_gossip``)
-    guarantees it can still serve the request."""
+    max_iwant_length: int,
+) -> tuple[jax.Array, jax.Array]:
+    """IWANT phase with promise accounting over packed windows ->
+    (pend u32[N, W], broken f32[N, K]).
+
+    Bit-exact with :func:`gossip.iwant_select` (see its docstring for the
+    protocol rules: one first-advertiser ask per id, word-granular
+    ``max_iwant_length`` budget per advertiser, broken-promise counts for
+    muted/dead advertisers).  The transfer lands via the caller's pend
+    fold — the advertiser's mcache retention (``history_length >
+    history_gossip``) guarantees an honest advertiser can still serve."""
     want = adv_w & ~have_w[:, None, :] & _as_mask(edge_live)[:, :, None]
+    before = exclusive_or_scan(want, axis=1)
+    first = want & ~before                             # one advertiser per id
+    asked = cap_ihave_packed(first, max_iwant_length)
+    served = asked & _as_mask(serve_ok)[:, :, None]
     pend = jax.lax.reduce(
-        want, jnp.uint32(0), jax.lax.bitwise_or, dimensions=(1,)
+        served, jnp.uint32(0), jax.lax.bitwise_or, dimensions=(1,)
     )
-    return pend & _as_mask(alive)[:, None]
+    broken = (
+        jax.lax.population_count(asked & ~_as_mask(serve_ok)[:, :, None])
+        .sum(axis=-1)
+        .astype(jnp.float32)
+    )
+    return pend & _as_mask(alive)[:, None], broken

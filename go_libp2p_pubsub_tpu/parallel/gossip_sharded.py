@@ -44,7 +44,7 @@ _PEER_DIM_FIELDS = frozenset({
     "nbrs", "rev", "nbr_valid", "outbound", "alive", "subscribed",
     "edge_live", "nbr_sub", "mesh", "fanout", "fanout_age", "backoff",
     "counters", "gcounters", "scores", "have_w", "fresh_w",
-    "gossip_pend_w", "adv_w", "first_step",
+    "gossip_pend_w", "iwant_pend_w", "gossip_mute", "first_step",
 })
 _REPLICATED_FIELDS = frozenset({
     "msg_valid", "msg_birth", "msg_active", "msg_used", "key", "step",

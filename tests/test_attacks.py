@@ -76,6 +76,42 @@ def test_eclipse_rotated_out_and_delivery_restored():
     assert bool(gs.have_bool(st)[target, 1]), "eclipsed target must recover"
 
 
+def test_gossip_promise_spam_penalized():
+    """An advertise-heavily, serve-nothing gossip spammer accrues P7 broken
+    promises ORGANICALLY (no manual advertisement muting) until its global
+    score goes negative; honest peers accrue zero penalty and honest
+    traffic still delivers (VERDICT r3 item 6; spec's gossip promise
+    tracking)."""
+    from go_libp2p_pubsub_tpu.models.attacks import gossip_promise_spam_attack
+
+    gs, st, report, attackers = gossip_promise_spam_attack(
+        n_peers=64, n_attackers=8, n_rounds=10,
+        n_slots=16, conn_degree=8, msg_window=64,
+    )
+    pen = report["attacker_behaviour_penalty"]
+    assert pen[-1] > 0, "asks directed at mute advertisers must charge P7"
+    assert report["attacker_global_score"][-1] < 0, (
+        "P7 must push the promise-breaker's global score negative"
+    )
+    assert report["honest_behaviour_penalty_max"].max() == 0.0, (
+        "honest peers must never accrue promise penalties"
+    )
+    # Honest traffic still flows end-to-end to every HONEST peer after the
+    # trace.  Evicted spammers may miss messages — that is the defense
+    # working: peers scoring below the gossip/publish thresholds are
+    # neither advertised to nor flooded to, so a fully-evicted attacker
+    # loses service entirely.
+    import jax.numpy as _jnp
+
+    st = gs.publish(st, _jnp.int32(60), _jnp.int32(63), _jnp.asarray(True))
+    st = gs.run(st, 24)
+    have = np.asarray(gs.have_bool(st))[:, 63]
+    att = np.asarray(attackers)
+    assert have[~att].all(), (
+        f"honest peers missing delivery: {np.flatnonzero(~have & ~att)}"
+    )
+
+
 def test_backoff_graft_spam_penalized_and_evicted():
     """A peer that GRAFTs through its prune-backoff window accrues the P7
     behaviour penalty: its score goes negative and its graft acceptance

@@ -1,0 +1,106 @@
+"""base58btc codec + peer-id translation (``translPeerIDs`` analog,
+reference ``subtree.go:228-239``)."""
+
+import hashlib
+
+import pytest
+
+from go_libp2p_pubsub_tpu.utils.base58 import (
+    b58decode,
+    b58encode,
+    ed25519_pub_from_peer_id,
+    parse_peer_id,
+    peer_id_from_ed25519_pub,
+    peer_id_from_sha256,
+    transl_peer_ids,
+)
+
+# The standard base58 test vectors (Bitcoin's base58_encode_decode.json set).
+VECTORS = [
+    (b"", ""),
+    (b"\x61", "2g"),
+    (b"\x62\x62\x62", "a3gV"),
+    (b"\x63\x63\x63", "aPEr"),
+    (b"simply a long string", "2cFupjhnEsSn59qHXstmK2ffpLv2"),
+    (
+        bytes.fromhex("00eb15231dfceb60925886b67d065299925915aeb172c06647"),
+        "1NS17iag9jJgTHD1VXjvLCEnZuQ3rJDE9L",
+    ),
+    (bytes.fromhex("516b6fcd0f"), "ABnLTmg"),
+    (bytes.fromhex("bf4f89001e670274dd"), "3SEo3LWLoPntC"),
+    (bytes.fromhex("572e4794"), "3EFU7m"),
+    (bytes.fromhex("ecac89cad93923c02321"), "EJDM8drfXA6uyA"),
+    (bytes.fromhex("10c8511e"), "Rt5zm"),
+    (b"\x00" * 10, "1111111111"),
+]
+
+
+@pytest.mark.parametrize("raw,encoded", VECTORS)
+def test_b58_known_vectors(raw, encoded):
+    assert b58encode(raw) == encoded
+    assert b58decode(encoded) == raw
+
+
+def test_b58_round_trip_random():
+    import random
+
+    rng = random.Random(0)
+    for _ in range(50):
+        raw = bytes(rng.randrange(256) for _ in range(rng.randrange(0, 64)))
+        assert b58decode(b58encode(raw)) == raw
+
+
+def test_b58_rejects_excluded_characters():
+    for bad in ["0", "O", "I", "l", "livepeer-0"]:
+        with pytest.raises(ValueError):
+            b58decode(bad)
+
+
+def test_sha256_peer_id_qm_prefix():
+    # sha256 multihash ids start with "Qm" (0x12 0x20 leading bytes).
+    pid = peer_id_from_sha256(hashlib.sha256(b"some public key").digest())
+    assert pid.startswith("Qm")
+    assert parse_peer_id(pid)[0:2] == b"\x12\x20"
+
+
+def test_ed25519_peer_id_12d3koow_prefix_and_key_recovery():
+    # identity-multihash ed25519 ids start with "12D3KooW" and inline the key.
+    pub = bytes(range(32))
+    pid = peer_id_from_ed25519_pub(pub)
+    assert pid.startswith("12D3KooW")
+    assert ed25519_pub_from_peer_id(pid) == pub
+    # Digest-form ids cannot yield a key.
+    qm = peer_id_from_sha256(hashlib.sha256(pub).digest())
+    assert ed25519_pub_from_peer_id(qm) is None
+
+
+def test_parse_peer_id_rejects_malformed():
+    good = peer_id_from_ed25519_pub(b"\x07" * 32)
+    for bad in [
+        "",                      # empty
+        "abc0def",               # excluded char
+        b58encode(b"\x12\x1f" + b"\x00" * 31),   # wrong digest length
+        b58encode(b"\x99\x20" + b"\x00" * 32),   # unknown multihash code
+        b58encode(b"\x00\x24" + b"\x00\x00\x12\x20" + b"\x00" * 32),  # not ed25519 pb
+        good[:-1],               # truncation breaks the length header
+    ]:
+        with pytest.raises(ValueError):
+            parse_peer_id(bad)
+
+
+def test_transl_peer_ids_drops_malformed_keeps_valid():
+    a = peer_id_from_ed25519_pub(b"\x01" * 32)
+    b = peer_id_from_sha256(hashlib.sha256(b"b").digest())
+    out = transl_peer_ids([a, "not-base58-0", "", b, "QmtooShort"])
+    assert out == [a, b]
+
+
+def test_peerstore_validate_ids_boundary():
+    from go_libp2p_pubsub_tpu.net.transport import Peerstore
+
+    ps = Peerstore(validate_ids=True)
+    pid = peer_id_from_ed25519_pub(b"\x05" * 32)
+    ps.add(pid, "127.0.0.1", 1234)
+    assert ps.addr(pid) == ("127.0.0.1", 1234)
+    with pytest.raises(ValueError):
+        ps.add("livepeer-0", "127.0.0.1", 1)

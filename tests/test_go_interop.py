@@ -1,0 +1,309 @@
+"""Go-encoder interop transcripts replayed over real sockets.
+
+Every protocol frame a "Go peer" sends in this file is a HAND-AUTHORED byte
+string mirroring what Go's ``encoding/json`` + the reference's
+``writeMessage`` produce (``/root/reference/pubsub.go:122-134``) — none are
+produced by :func:`wire.encode_message`.  Go semantics each transcript pins:
+
+- ``json.Encoder.Encode`` emits compact JSON (no spaces), struct-declaration
+  field order (Type, data, parents, treewidth, treemaxwidth, numpeers), and
+  appends ``\\n`` after every value.
+- ``encoding/json`` HTML-escapes ``<``, ``>``, ``&`` inside strings as
+  ``\\u003c`` / ``\\u003e`` / ``\\u0026`` by default (json.Encoder's
+  SetEscapeHTML(true) default); other non-ASCII runes are raw UTF-8.
+- ``[]byte`` marshals as padded standard base64 under the ``data`` key.
+- ``Type`` has no json tag: always present, integer, capital-T key; all other
+  fields are ``omitempty``.
+- The decoder side finds object boundaries itself, however the bytes are
+  chunked — whitespace between objects is insignificant.
+
+The transcripts drive the full live-plane behavior: join→welcome admission,
+a redirect chain, Data delivery (binary payload), State accounting with
+UTF-8 + HTML-escaped peer ids, and an unsolicited repair Update adoption —
+with frames split at every byte boundary.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from go_libp2p_pubsub_tpu.net import LiveNetwork
+
+# ---------------------------------------------------------------------------
+# raw-socket Go-peer helpers (no wire.py involvement on the send side)
+# ---------------------------------------------------------------------------
+
+
+async def read_frame(reader: asyncio.StreamReader) -> dict:
+    """Read one frame the way Go's json.Decoder would see it.
+
+    Our encoder never emits raw newlines inside strings (JSON escapes control
+    chars), so line-splitting finds the same boundaries Go's Decoder does.
+    """
+    line = await reader.readline()
+    assert line.endswith(b"\n"), f"truncated frame: {line!r}"
+    return json.loads(line)
+
+
+async def go_dial(net, target_id: str, protoid: str, go_id: str):
+    """Dial one of OUR hosts the way a Go peer would reach the transport:
+    hand-written handshake line, then raw wire frames."""
+    host, port = net.peerstore.addr(target_id)
+    reader, writer = await asyncio.open_connection(host, port)
+    hs = '{"proto":"%s","peer":"%s"}\n' % (protoid, go_id)
+    writer.write(hs.encode())
+    await writer.drain()
+    return reader, writer
+
+
+class FakeGoPeer:
+    """A raw asyncio server standing in for a Go peer: accepts our
+    transport handshake, then runs a scripted exchange of hand-authored
+    bytes.  Registers itself in the peerstore so our side can dial it."""
+
+    def __init__(self, net, peer_id: str, script):
+        self.net = net
+        self.id = peer_id
+        self.script = script  # async fn(self, reader, writer)
+        self.server = None
+        self.conns = []
+
+    async def start(self):
+        self.server = await asyncio.start_server(self._accept, "127.0.0.1", 0)
+        port = self.server.sockets[0].getsockname()[1]
+        self.net.peerstore.add(self.id, "127.0.0.1", port)
+
+    async def _accept(self, reader, writer):
+        self.conns.append(writer)
+        hs = json.loads(await reader.readline())  # our dialer's handshake
+        await self.script(self, hs, reader, writer)
+
+
+def run(net, coro, timeout=20.0):
+    return asyncio.run_coroutine_threadsafe(coro, net._loop).result(timeout)
+
+
+@pytest.fixture
+def net():
+    n = LiveNetwork(repair_timeout_s=2.0)
+    yield n
+    n.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# 1. Go joiner against our root: join → welcome, Data out, State in (UTF-8)
+# ---------------------------------------------------------------------------
+
+
+def test_go_joiner_admitted_by_our_root_and_receives_data(net):
+    host = net.host()
+    topic = host.new_topic("foobar")
+    protoid = f"{host.id}/foobar"
+
+    async def scenario():
+        r, w = await go_dial(net, host.id, protoid, "go-joiner")
+        # Go writeMessage(Join): zero-valued fields omitempty, Type always
+        # present (pubsub.go:146-153; subtree.go:197-199).
+        w.write(b'{"Type":1}\n')
+        await w.drain()
+        # Our welcome must parse as Go would: Type=3 Update naming the
+        # sender as parent plus fanout params (subtree.go:121-128).
+        welcome = await read_frame(r)
+        assert welcome["Type"] == 3
+        assert welcome["parents"] == [host.id]
+        assert welcome["treewidth"] == 2 and welcome["treemaxwidth"] == 5
+        # Child→parent accounting with adversarial ids: Go HTML-escapes
+        # '<'/'>' ('<'/'>') and sends 'é' as raw UTF-8 bytes
+        # (json key is "parents" for the Peers field, pubsub.go:149).
+        state = '{"Type":4,"parents":["go-kid-\\u003cA\\u003e","péer-✓"],"numpeers":2}\n'
+        w.write(state.encode("utf-8"))
+        await w.drain()
+        await asyncio.sleep(0.2)
+        child = topic.topic.node.children["go-joiner"]
+        assert child.size == 3  # wire formula size = NumPeers + 1 (subtree.go:59)
+        assert child.child_ids == ["go-kid-<A>", "péer-✓"]
+        # Data fan-out reaches the Go child as base64 under "data".
+        payload = bytes(range(256))
+        await topic.topic.publish_message(payload)
+        data = await read_frame(r)
+        assert data["Type"] == 0
+        import base64 as b64
+        assert b64.b64decode(data["data"]) == payload
+        w.close()
+
+    run(net, scenario())
+
+
+# ---------------------------------------------------------------------------
+# 2. Our subscriber walks a Go redirect chain, then receives binary Data
+# ---------------------------------------------------------------------------
+
+
+def test_our_subscriber_walks_go_redirect_chain(net):
+    host = net.host()
+    protoid = "goroot/t"
+    delivered_all = asyncio.Event()
+
+    async def root_script(peer, hs, reader, writer):
+        assert hs == {"proto": protoid, "peer": host.id}
+        join = await read_frame(reader)
+        assert join == {"Type": 1}
+        # Redirect Update: parents != sender means "try this peer instead"
+        # (subtree.go:180-185; receiver check subtree.go:283).
+        writer.write(b'{"Type":3,"parents":["gochild"]}\n')
+        await writer.drain()
+
+    async def child_script(peer, hs, reader, writer):
+        join = await read_frame(reader)
+        assert join == {"Type": 1}
+        # Welcome naming myself: accepted (subtree.go:121-128).  Sent SPLIT
+        # at every byte boundary to exercise incremental decode.
+        welcome = b'{"Type":3,"parents":["gochild"],"treewidth":2,"treemaxwidth":5}\n'
+        for i in range(len(welcome)):
+            writer.write(welcome[i : i + 1])
+            await writer.drain()
+        # Our side sends State right after joining; consume it.
+        state = await read_frame(reader)
+        assert state["Type"] == 4
+        # Two Data frames in ONE write (boundary inside the chunk), then one
+        # dripped byte-by-byte.  Payloads: binary 0x00..0x07 -> "AAECAwQFBgc="
+        # and 0xff,0xfe -> "//4=" (Go base64.StdEncoding with padding).
+        writer.write(
+            b'{"Type":0,"data":"AAECAwQFBgc="}\n{"Type":0,"data":"//4="}\n'
+        )
+        await writer.drain()
+        third = b'{"Type":0,"data":"AQI="}\n'
+        for i in range(len(third)):
+            writer.write(third[i : i + 1])
+            await writer.drain()
+        await delivered_all.wait()
+        writer.close()
+
+    async def scenario():
+        root = FakeGoPeer(net, "goroot", root_script)
+        child = FakeGoPeer(net, "gochild", child_script)
+        await root.start()
+        await child.start()
+        from go_libp2p_pubsub_tpu.net.live import LiveTopicManager
+
+        tm = LiveTopicManager(host.live, repair_timeout_s=2.0)
+        sub = await tm.subscribe("goroot", "t")
+        got = [await asyncio.wait_for(sub.out.get(), 5.0) for _ in range(3)]
+        assert got == [bytes(range(8)), b"\xff\xfe", b"\x01\x02"]
+        delivered_all.set()
+        await sub.close()
+
+    run(net, scenario())
+
+
+# ---------------------------------------------------------------------------
+# 3. Parent death → unsolicited repair Update from a Go repairer → adoption
+# ---------------------------------------------------------------------------
+
+
+def test_unsolicited_go_repair_update_adopts_our_subscriber(net):
+    host = net.host()
+    protoid = "gopar1/t"
+    par1_done = asyncio.Event()
+    repaired = asyncio.Event()
+
+    async def par1_script(peer, hs, reader, writer):
+        await read_frame(reader)  # Join
+        writer.write(
+            b'{"Type":3,"parents":["gopar1"],"treewidth":2,"treemaxwidth":5}\n'
+        )
+        await writer.drain()
+        await read_frame(reader)  # State
+        # One delivery, then die abruptly (the TestNodesDropping fault).
+        writer.write(b'{"Type":0,"data":"aGVsbG8="}\n')  # "hello"
+        await writer.drain()
+        await par1_done.wait()
+        writer.transport.abort()
+
+    async def par2_script(peer, hs, reader, writer):
+        # Adopted-orphan handoff: the repairer DIALS the orphan and sends an
+        # unsolicited welcome Update (subtree.go:369 via redistributeChildren;
+        # orphan side client.go:49-59).
+        welcome = b'{"Type":3,"parents":["gopar2"],"treewidth":2,"treemaxwidth":5}\n'
+        # Split mid-multibyte boundary safety: drip in 3-byte chunks.
+        for i in range(0, len(welcome), 3):
+            writer.write(welcome[i : i + 3])
+            await writer.drain()
+        state = await read_frame(reader)  # orphan re-reports its subtree
+        assert state["Type"] == 4
+        writer.write(b'{"Type":0,"data":"d29ybGQ="}\n')  # "world"
+        await writer.drain()
+        await repaired.wait()
+        writer.close()
+
+    async def scenario():
+        par1 = FakeGoPeer(net, "gopar1", par1_script)
+        await par1.start()
+        from go_libp2p_pubsub_tpu.net.live import LiveTopicManager
+
+        tm = LiveTopicManager(host.live, repair_timeout_s=3.0)
+        sub = await tm.subscribe("gopar1", "t")
+        assert await asyncio.wait_for(sub.out.get(), 5.0) == b"hello"
+        par1_done.set()  # parent dies
+        await asyncio.sleep(0.1)
+        # The Go repairer DIALS our subscriber's protocol handler directly
+        # (no server needed on the repairer side) and runs its script over
+        # the outbound connection.
+        host_addr, port = net.peerstore.addr(host.id)
+        r2, w2 = await asyncio.open_connection(host_addr, port)
+        w2.write(('{"proto":"%s","peer":"gopar2"}\n' % protoid).encode())
+        await w2.drain()
+        repair_task = asyncio.ensure_future(par2_script(None, None, r2, w2))
+        assert await asyncio.wait_for(sub.out.get(), 5.0) == b"world"
+        repaired.set()
+        await repair_task
+        await sub.close()
+
+    run(net, scenario())
+
+
+# ---------------------------------------------------------------------------
+# 4. Whole-transcript byte-at-a-time replay (every frame boundary exercised)
+# ---------------------------------------------------------------------------
+
+
+def test_full_go_transcript_byte_by_byte(net):
+    """A complete welcome + 3-Data transcript (with inter-frame whitespace Go
+    decoders tolerate, a UTF-8 peer id, and HTML escapes) dripped one byte at
+    a time into our subscriber."""
+    host = net.host()
+    done = asyncio.Event()
+
+    async def root_script(peer, hs, reader, writer):
+        await read_frame(reader)  # Join
+        transcript = (
+            # Welcome naming the sender (raw UTF-8 'ö' as Go emits it) with
+            # non-default fanout params our side must validate-and-adopt.
+            b'{"Type":3,"parents":["g\xc3\xb6root"],"treewidth":3,"treemaxwidth":6}\n'
+            b'{"Type":0,"data":"QQ=="}\n'       # "A"
+            b'  {"Type":0,"data":"QkI="}\n'     # "BB" after stray whitespace
+            b'{"Type":0,"data":"+/8="}\n'       # 0xfb 0xff: exercises the
+            #                       +, / and pad chars of Go's StdEncoding
+        )
+        for i in range(len(transcript)):
+            writer.write(transcript[i : i + 1])
+            await writer.drain()
+        await done.wait()
+        writer.close()
+
+    async def scenario():
+        root = FakeGoPeer(net, "göroot", root_script)
+        await root.start()
+        from go_libp2p_pubsub_tpu.net.live import LiveTopicManager
+
+        tm = LiveTopicManager(host.live, repair_timeout_s=2.0)
+        sub = await tm.subscribe("göroot", "t")
+        # Fanout params from the welcome were validated and adopted.
+        assert (sub.node.width, sub.node.max_width) == (3, 6)
+        got = [await asyncio.wait_for(sub.out.get(), 5.0) for _ in range(3)]
+        assert got == [b"A", b"BB", b"\xfb\xff"]
+        done.set()
+        await sub.close()
+
+    run(net, scenario())

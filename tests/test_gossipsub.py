@@ -205,16 +205,16 @@ def test_floodsub_stats_ignore_invalid_messages():
     assert float(p50) >= 0
 
 
-def test_publish_recycle_clears_stale_ihave(gs):
-    """Recycling a window slot must clear it from the pending IHAVE snapshot
-    too: a stale advertisement of the OLD message in the slot would become a
-    phantom IWANT delivery of the NEW message."""
+def test_publish_recycle_clears_stale_iwant_grants(gs):
+    """Recycling a window slot must clear it from the pending IWANT grants
+    too: a stale granted transfer of the OLD message in the slot would
+    become a phantom delivery of the NEW message."""
     st = gs.init(seed=11)
-    st = st._replace(adv_w=jnp.full_like(st.adv_w, 0xFFFFFFFF))
+    st = st._replace(iwant_pend_w=jnp.full_like(st.iwant_pend_w, 0xFFFFFFFF))
     st = gs.publish(st, jnp.int32(0), jnp.int32(5), jnp.asarray(True))
-    adv = np.asarray(st.adv_w)
-    assert not (adv & (1 << 5)).any(), "slot 5 must be struck from adv_w"
-    assert (adv & (1 << 6)).all(), "other slots' advertisements untouched"
+    iw = np.asarray(st.iwant_pend_w)
+    assert not (iw & (1 << 5)).any(), "slot 5 must be struck from iwant_pend_w"
+    assert (iw & (1 << 6)).all(), "other slots' grants untouched"
 
 
 def test_outbound_swap_never_exceeds_degree():

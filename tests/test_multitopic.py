@@ -130,17 +130,17 @@ def test_publish_advances_topic_key():
     np.testing.assert_array_equal(k_before[0], k_after[0])
 
 
-def test_publish_recycle_clears_stale_ihave_multitopic():
-    """Recycling a window slot clears pending IHAVE snapshots for that slot
-    in the published topic (stale advertisements would turn into phantom
-    IWANT deliveries of the NEW message)."""
+def test_publish_recycle_clears_stale_iwant_grants_multitopic():
+    """Recycling a window slot clears pending IWANT grants for that slot in
+    the published topic (a stale granted transfer of the OLD message would
+    become a phantom delivery of the NEW one)."""
     mt = MultiTopicGossipSub(
         n_topics=2, n_peers=32, n_slots=8, conn_degree=4, msg_window=8
     )
     st = mt.init(seed=0)
-    full = jnp.full_like(st.adv_w, 0xFFFFFFFF)
-    st = st._replace(adv_w=full)
+    full = jnp.full_like(st.iwant_pend_w, 0xFFFFFFFF)
+    st = st._replace(iwant_pend_w=full)
     st = mt.publish(st, jnp.int32(0), jnp.int32(0), jnp.int32(3), jnp.asarray(True))
-    adv = np.asarray(st.adv_w)
-    assert not (adv[0] & (1 << 3)).any(), "slot 3 IHAVEs must be struck in topic 0"
-    assert (adv[1] & (1 << 3)).all(), "other topics' snapshots untouched"
+    iw = np.asarray(st.iwant_pend_w)
+    assert not (iw[0] & (1 << 3)).any(), "slot 3 grants must be struck in topic 0"
+    assert (iw[1] & (1 << 3)).all(), "other topics' grants untouched"
