@@ -1,8 +1,11 @@
 """Attack-trace scenarios: scoring must defeat each scripted adversary."""
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from go_libp2p_pubsub_tpu.config import ScoreParams
 from go_libp2p_pubsub_tpu.models.attacks import (

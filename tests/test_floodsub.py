@@ -1,5 +1,9 @@
 """FloodSub model tests."""
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import jax.numpy as jnp
 import numpy as np
 

@@ -5,10 +5,13 @@ delivers, and (b) sharding does not change the computation: leaf-for-leaf
 bit-equality with the unsharded model after identical event sequences.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 from jax.sharding import NamedSharding
 
 from go_libp2p_pubsub_tpu.models.gossipsub import GossipSub

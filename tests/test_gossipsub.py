@@ -1,8 +1,11 @@
 """GossipSub model tests: mesh invariants, delivery, scoring under attack."""
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from go_libp2p_pubsub_tpu.config import GossipSubParams, ScoreParams
 from go_libp2p_pubsub_tpu.models.gossipsub import GossipSub, build_topology

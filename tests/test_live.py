@@ -8,9 +8,12 @@ protocol — the closest analog of the reference's own in-process
 ``pubsub_test.go:27-35``).
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import time
 
-import pytest
 
 from go_libp2p_pubsub_tpu.net import LiveNetwork
 

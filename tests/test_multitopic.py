@@ -1,8 +1,11 @@
 """Multi-topic GossipSub: isolation, subscription masking, cross-topic scoring."""
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from go_libp2p_pubsub_tpu.config import ScoreParams
 from go_libp2p_pubsub_tpu.models.multitopic import MultiTopicGossipSub

@@ -4,9 +4,12 @@ Runs in Pallas interpret mode on the CPU test mesh; the same kernel compiles
 via Mosaic on the TPU chip (exercised by bench.py and the TPU smoke flow).
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from go_libp2p_pubsub_tpu.models.gossipsub import build_topology
 from go_libp2p_pubsub_tpu.ops import bitpack

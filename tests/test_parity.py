@@ -9,6 +9,9 @@ timeouts/settles map to lockstep step budgets.
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
+
 from go_libp2p_pubsub_tpu.api import (
     SimNetwork,
     Subscription,

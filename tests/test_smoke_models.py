@@ -4,10 +4,79 @@ Round 2 shipped three init/import-time breakages that a test like this would
 have caught in seconds: every model family must construct, init, accept a
 publish, and step at tiny shapes.  Keep this file FAST — it is the first
 thing to run after any refactor (`pytest tests/test_smoke_models.py`).
+
+Two tiers: the compiled one-step smokes below, and
+``test_all_families_trace_smoke`` — an abstract ``jax.eval_shape`` pass over
+init/publish/step of every family that catches import- and trace-time
+breakage (shape mismatches, renamed state fields, bad indexing) in a couple
+of seconds with ZERO compilation.  The eval_shape tier always runs in the
+fast gate; the compiled smokes for the families with expensive jit warmups
+(multitopic, sharded, attack traces) are marked slow.
 """
 
+import pytest
 import jax.numpy as jnp
 import numpy as np
+
+
+def test_all_families_trace_smoke():
+    """Abstract-trace every model family's init/publish/step (no compile).
+
+    ``jax.eval_shape`` executes the host-side code concretely (topology
+    builders, field classification) and traces all device code abstractly —
+    the exact class of breakage round 2 shipped fails here in seconds.
+    """
+    import jax
+
+    # -- multitopic --------------------------------------------------------
+    from go_libp2p_pubsub_tpu.models.multitopic import MultiTopicGossipSub
+
+    mt = MultiTopicGossipSub(
+        n_topics=2, n_peers=16, n_slots=8, conn_degree=4, msg_window=4
+    )
+    mt_st = jax.eval_shape(lambda: mt.init(seed=0))
+    jax.eval_shape(
+        mt.publish, mt_st,
+        jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.asarray(True),
+    )
+    jax.eval_shape(mt.step, mt_st)
+
+    # -- sharded gossipsub: field-classification + shardings construction --
+    from go_libp2p_pubsub_tpu.models.gossipsub import GossipSub
+    from go_libp2p_pubsub_tpu.parallel.gossip_sharded import (
+        gossip_state_shardings,
+    )
+    from go_libp2p_pubsub_tpu.parallel.mesh import make_mesh
+
+    g = GossipSub(
+        n_peers=16, n_slots=8, conn_degree=4, msg_window=4, use_pallas=False
+    )
+    g_st = jax.eval_shape(lambda: g.init(seed=0))
+    # Raises if any GossipState field lacks a sharding rule (the exact
+    # breakage a state-field add/rename would introduce).
+    gossip_state_shardings(g_st, make_mesh(1), g.n)
+    jax.eval_shape(g.step, g_st)
+
+    # -- attack traces: the in-scan metric reductions trace over the model -
+    from go_libp2p_pubsub_tpu.models.attacks import _attacker_metrics
+
+    attackers = jnp.zeros((g.n,), bool).at[0].set(True)
+    jax.eval_shape(lambda s: _attacker_metrics(g, s, attackers), g_st)
+
+    # -- treecast / floodsub (cheap anyway, but keep the tier complete) ----
+    from go_libp2p_pubsub_tpu.config import SimParams, TreeOpts
+    from go_libp2p_pubsub_tpu.models.floodsub import FloodSub
+    from go_libp2p_pubsub_tpu.models.treecast import TreeCast
+    from go_libp2p_pubsub_tpu.ops import tree as tree_ops
+
+    fs = FloodSub(n_peers=16, n_slots=8, conn_degree=4, msg_window=4)
+    fs_st = jax.eval_shape(lambda: fs.init(seed=0))
+    jax.eval_shape(lambda s: fs.run(s, 4), fs_st)  # n_steps must stay static
+    TreeCast(SimParams(max_peers=16))  # ctor validation
+    t_st = jax.eval_shape(
+        lambda: tree_ops.init_state(SimParams(max_peers=16), TreeOpts(), root=0)
+    )
+    jax.eval_shape(tree_ops.step, t_st)
 
 
 def test_treecast_smoke():
@@ -41,6 +110,9 @@ def test_gossipsub_smoke():
     assert int(st.step) == 1
 
 
+@pytest.mark.slow
+
+
 def test_multitopic_smoke():
     from go_libp2p_pubsub_tpu.models.multitopic import MultiTopicGossipSub
 
@@ -53,6 +125,9 @@ def test_multitopic_smoke():
     )
     st = mt.step(st)
     assert int(st.step) == 1
+
+
+@pytest.mark.slow
 
 
 def test_sharded_gossipsub_smoke():
@@ -69,6 +144,9 @@ def test_sharded_gossipsub_smoke():
     st = sg.publish(st, jnp.asarray(0), jnp.asarray(0), jnp.asarray(True))
     st = sg.run(st, 4)
     assert int(st.step) == 4
+
+
+@pytest.mark.slow
 
 
 def test_attack_traces_smoke():

@@ -104,6 +104,8 @@ class TestMetrics:
         m2 = metrics.snapshot(metrics.tree_metrics(st))
         assert m2["msgs_delivered_total"] == 2 * 7  # every subscriber, 2 msgs
 
+    @pytest.mark.slow
+
     def test_gossip_metrics_delivery(self):
         gs = GossipSub(n_peers=64, n_slots=16, conn_degree=8, msg_window=8)
         st = gs.init(seed=0)
@@ -160,6 +162,8 @@ class TestFaults:
         out_len = np.asarray(out.out_len)
         live_subs = [p for p in range(1, 8) if p != 3]
         assert all(out_len[p] > 0 for p in live_subs)
+
+    @pytest.mark.slow
 
     def test_run_with_faults_gossip(self):
         gs = GossipSub(n_peers=64, n_slots=16, conn_degree=8, msg_window=8)
