@@ -100,6 +100,19 @@ class SimNetwork:
             for eng in self.engines.values():
                 eng.step()
 
+    def set_link_profile(self, delay, drop_prob) -> None:
+        """Install per-edge latency/drop tensors on every registered topic's
+        fabric (the mocknet analog's link model, SURVEY §2.3).
+
+        ``delay`` i32[N, W] extra steps per (parent, child-slot) edge;
+        ``drop_prob`` f32[N, W] silent per-copy loss probability.  Applies to
+        topics that exist now — create topics first, then shape the network.
+        """
+        d = jnp.asarray(delay)
+        p = jnp.asarray(drop_prob)
+        for eng in self.engines.values():
+            eng.state = tree_ops.set_link_profile(eng.state, d, p)
+
     # -- used by host/topic objects -----------------------------------------
     def _engine(self, protoid: str) -> _TopicEngine:
         try:

@@ -74,6 +74,12 @@ class GossipState(NamedTuple):
     gossip_mute: jax.Array  # bool[N] peers that advertise but never serve
                             # IWANTs (promise-breaking adversary model; their
                             # refusals charge P7)
+    gossip_delay: jax.Array  # i32[N] ingress link latency: extra rounds a
+                             # peer's pending gossip/flood transfers wait
+                             # before folding into receipts (the per-edge
+                             # delay model mirrored into the pend fold;
+                             # 0 = ideal fabric)
+    pend_hold: jax.Array     # i32[N] countdown until the pend fold is ready
     first_step: jax.Array   # i32[N, M] first-receipt step, -1 = never
     msg_valid: jax.Array    # bool[M] validation verdict
     msg_birth: jax.Array    # i32[M] publish step
@@ -323,6 +329,8 @@ class GossipSub:
             gossip_pend_w=jnp.zeros((n, w), jnp.uint32),
             iwant_pend_w=jnp.zeros((n, w), jnp.uint32),
             gossip_mute=jnp.zeros((n,), bool),
+            gossip_delay=jnp.zeros((n,), jnp.int32),
+            pend_hold=jnp.zeros((n,), jnp.int32),
             first_step=jnp.full((n, m), -1, jnp.int32),
             msg_valid=jnp.zeros((m,), bool),
             msg_birth=jnp.zeros((m,), jnp.int32),
@@ -413,15 +421,31 @@ class GossipSub:
                 jnp.where(is_sub, st.fanout_age[src], 0)
             )
         # Offered copies land next round through the pend fold (one hop of
-        # latency, like any send).  Valid-only: see docstring.
+        # latency, like any send).  Valid-only: see docstring.  A receiver
+        # with ingress latency arms its hold now — but only if no hold is
+        # already counting (bits arriving mid-hold join the in-flight batch;
+        # re-arming would let sustained traffic defer the fold forever) and
+        # only when a bit was actually placed (``valid`` — an invalid
+        # publish must not touch victims' receive latency).
         bm = bitpack.bit_mask(slot, self.w)                      # u32[W]
         rows = jnp.where(targets, st.nbrs[src], n)
-        gathered = pend_w[jnp.clip(rows, 0, n - 1)]              # u32[K, W]
+        rows_c = jnp.clip(rows, 0, n - 1)
+        gathered = pend_w[rows_c]                                # u32[K, W]
         upd = gathered | jnp.where(valid, bm, jnp.uint32(0))[None, :]
         pend_w = pend_w.at[rows].set(upd, mode="drop")
+        # Arm only on an idle, EMPTY row: a row whose hold just expired still
+        # carries a batch due to fold next round — arming again would defer
+        # that due traffic by a fresh delay (the new bit instead joins the
+        # due batch and lands early, the lesser distortion).
+        cur_hold = st.pend_hold[rows_c]
+        arm = valid & (cur_hold <= 0) & (gathered == 0).all(axis=-1)
+        pend_hold = st.pend_hold.at[rows].set(
+            jnp.where(arm, st.gossip_delay[rows_c], cur_hold), mode="drop"
+        )
         return st._replace(
             have_w=have_w, fresh_w=fresh_w, gossip_pend_w=pend_w,
-            iwant_pend_w=iwant_pend_w, first_step=first_step, msg_valid=mv,
+            iwant_pend_w=iwant_pend_w, pend_hold=pend_hold,
+            first_step=first_step, msg_valid=mv,
             msg_birth=mb, msg_active=ma, msg_used=mu, fanout=fanout,
             fanout_age=fanout_age, key=knext,
         )
@@ -435,6 +459,15 @@ class GossipSub:
             alive=alive,
             edge_live=compute_edge_live(st.nbr_valid, st.nbrs, alive),
         )
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def set_gossip_delay(self, st: GossipState, delay: jax.Array) -> GossipState:
+        """Install per-peer ingress gossip latency (i32[N] extra rounds a
+        peer's pending gossip/flood transfers wait before folding into its
+        receipts).  The pend-fold mirror of the tree fabric's per-edge
+        ``set_link_profile`` delay (SURVEY §2.3); zeros restore the ideal
+        one-round fabric."""
+        return st._replace(gossip_delay=delay.astype(jnp.int32))
 
     @functools.partial(jax.jit, static_argnums=0)
     def set_gossip_mute(self, st: GossipState, mask: jax.Array) -> GossipState:
@@ -621,10 +654,16 @@ class GossipSub:
         # relay NEXT round (they join fresh_w after the eager push below) —
         # merging them into the relayed set here would move a message two
         # hops in one round, which both breaks wire parity and zeroes the
-        # measured hop latency.
+        # measured hop latency.  A peer with ingress latency (gossip_delay)
+        # holds its pending transfers for that many extra rounds before they
+        # fold; bits arriving mid-hold join the held batch.
+        ready = st.pend_hold <= 0
+        ready_w = gossip_ops._as_mask(ready)[:, None]
         gossip_new = (
-            st.gossip_pend_w & ~st.have_w & gossip_ops._as_mask(st.alive)[:, None]
+            st.gossip_pend_w & ready_w & ~st.have_w
+            & gossip_ops._as_mask(st.alive)[:, None]
         )
+        held_w = st.gossip_pend_w & ~ready_w
         have_w = st.have_w | gossip_new
 
         # Eager push over the mesh, graylist-gated receiver-side: frames
@@ -663,16 +702,25 @@ class GossipSub:
             invalid_message_deliveries=st.counters.invalid_message_deliveries
             + out.invalid_inc,
         )
+        # The heartbeat's granted IWANT transfers become next round's pend
+        # fold (the second wire hop of the gossip exchange), joining any
+        # bits still held by ingress latency.
+        pend_next = held_w | st.iwant_pend_w
+        incoming = (pend_next != 0).any(axis=1)
+        pend_hold = jnp.where(
+            ready,
+            jnp.where(incoming, st.gossip_delay, 0),
+            st.pend_hold - 1,
+        )
         return st._replace(
             have_w=out.have_w,
             # Pend-fold arrivals relay on the NEXT round (one hop per round).
             fresh_w=out.fresh_w | gossip_new,
             first_step=first_step,
             counters=c,
-            # The heartbeat's granted IWANT transfers become next round's
-            # pend fold — the second wire hop of the gossip exchange.
-            gossip_pend_w=st.iwant_pend_w,
+            gossip_pend_w=pend_next,
             iwant_pend_w=jnp.zeros_like(st.iwant_pend_w),
+            pend_hold=pend_hold,
         )
 
     @functools.partial(jax.jit, static_argnums=0)

@@ -66,6 +66,9 @@ class MultiTopicState(NamedTuple):
     iwant_pend_w: jax.Array  # u32[T, N, W] heartbeat-granted IWANT transfers
     gossip_mute: jax.Array   # bool[N] promise-breakers (shared: an attacker
                              # that never serves IWANTs is mute in every topic)
+    gossip_delay: jax.Array  # i32[N] ingress gossip latency (shared: links,
+                             # not topics, are slow)
+    pend_hold: jax.Array     # i32[T, N] per-topic pend-fold countdown
     first_step: jax.Array    # i32[T, N, M]
     msg_valid: jax.Array     # bool[T, M]
     msg_birth: jax.Array     # i32[T, M]
@@ -147,6 +150,8 @@ class MultiTopicGossipSub:
             gossip_pend_w=jnp.zeros((t, n, w), jnp.uint32),
             iwant_pend_w=jnp.zeros((t, n, w), jnp.uint32),
             gossip_mute=jnp.zeros((n,), bool),
+            gossip_delay=jnp.zeros((n,), jnp.int32),
+            pend_hold=jnp.zeros((t, n), jnp.int32),
             first_step=jnp.full((t, n, m), -1, jnp.int32),
             msg_valid=jnp.zeros((t, m), bool),
             msg_birth=jnp.zeros((t, m), jnp.int32),
@@ -220,16 +225,25 @@ class MultiTopicGossipSub:
             fanout_age = st.fanout_age.at[topic, src].set(
                 jnp.where(is_sub, st.fanout_age[topic, src], 0)
             )
+        # Hold arming mirrors the single-topic publish exactly: only on an
+        # idle empty row, only when a bit was placed (see GossipSub.publish).
         bm = bitpack.bit_mask(slot, self.w)
         rows = jnp.where(targets, st.nbrs[src], n)
-        gathered = pend_t[jnp.clip(rows, 0, n - 1)]
+        rows_c = jnp.clip(rows, 0, n - 1)
+        gathered = pend_t[rows_c]
         upd = gathered | jnp.where(valid, bm, jnp.uint32(0))[None, :]
         pend_t = pend_t.at[rows].set(upd, mode="drop")
+        cur_hold = st.pend_hold[topic][rows_c]
+        arm = valid & (cur_hold <= 0) & (gathered == 0).all(axis=-1)
+        hold_t = st.pend_hold[topic].at[rows].set(
+            jnp.where(arm, st.gossip_delay[rows_c], cur_hold), mode="drop"
+        )
         return st._replace(
             have_w=st.have_w.at[topic].set(have_t),
             fresh_w=st.fresh_w.at[topic].set(fresh_t),
             gossip_pend_w=st.gossip_pend_w.at[topic].set(pend_t),
             iwant_pend_w=st.iwant_pend_w.at[topic].set(iwant_t),
+            pend_hold=st.pend_hold.at[topic].set(hold_t),
             first_step=st.first_step.at[topic].set(fs_t),
             msg_valid=st.msg_valid.at[topic].set(mv),
             msg_birth=st.msg_birth.at[topic].set(mb),
@@ -239,6 +253,14 @@ class MultiTopicGossipSub:
             fanout_age=fanout_age,
             keys=st.keys.at[topic].set(knext),
         )
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def set_gossip_delay(
+        self, st: MultiTopicState, delay: jax.Array
+    ) -> MultiTopicState:
+        """Install shared per-peer ingress gossip latency (i32[N]); see
+        ``GossipSub.set_gossip_delay``."""
+        return st._replace(gossip_delay=delay.astype(jnp.int32))
 
     @functools.partial(jax.jit, static_argnums=0)
     def set_gossip_mute(
@@ -274,7 +296,7 @@ class MultiTopicGossipSub:
                                 jnp.int32)
 
         def one(mesh, fanout, backoff, counters, have_w, fresh_w, pend_w,
-                iwant_w, first_step, mv, mb, ma, mu, key, al, el, sub):
+                iwant_w, hold, first_step, mv, mb, ma, mu, key, al, el, sub):
             g = GossipState(
                 nbrs=st.nbrs, rev=st.rev, nbr_valid=st.nbr_valid,
                 outbound=st.outbound, alive=al, subscribed=sub,
@@ -282,23 +304,27 @@ class MultiTopicGossipSub:
                 fanout_age=inactive_age, backoff=backoff, counters=counters,
                 gcounters=st.gcounters, scores=st.scores, have_w=have_w,
                 fresh_w=fresh_w, gossip_pend_w=pend_w, iwant_pend_w=iwant_w,
-                gossip_mute=st.gossip_mute, first_step=first_step,
+                gossip_mute=st.gossip_mute, gossip_delay=st.gossip_delay,
+                pend_hold=hold, first_step=first_step,
                 msg_valid=mv, msg_birth=mb, msg_active=ma, msg_used=mu,
                 key=key, step=st.step,
             )
             o = gs._propagate(g)
             return (o.counters, o.have_w, o.fresh_w, o.gossip_pend_w,
-                    o.iwant_pend_w, o.first_step)
+                    o.iwant_pend_w, o.pend_hold, o.first_step)
 
-        counters, have_w, fresh_w, pend_w, iwant_w, first_step = jax.vmap(one)(
+        (counters, have_w, fresh_w, pend_w, iwant_w, hold,
+         first_step) = jax.vmap(one)(
             st.mesh, st.fanout, st.backoff, st.counters, st.have_w,
-            st.fresh_w, st.gossip_pend_w, st.iwant_pend_w, st.first_step,
-            st.msg_valid, st.msg_birth, st.msg_active, st.msg_used, st.keys,
-            self._topic_alive(st), st.edge_live, st.subscribed,
+            st.fresh_w, st.gossip_pend_w, st.iwant_pend_w, st.pend_hold,
+            st.first_step, st.msg_valid, st.msg_birth, st.msg_active,
+            st.msg_used, st.keys, self._topic_alive(st), st.edge_live,
+            st.subscribed,
         )
         return st._replace(
             counters=counters, have_w=have_w, fresh_w=fresh_w,
-            gossip_pend_w=pend_w, iwant_pend_w=iwant_w, first_step=first_step,
+            gossip_pend_w=pend_w, iwant_pend_w=iwant_w, pend_hold=hold,
+            first_step=first_step,
         )
 
     def _heartbeat(self, st: MultiTopicState) -> MultiTopicState:

@@ -70,18 +70,43 @@ class TreeState(NamedTuple):
     join_wait: jax.Array     # i32[N]  steps spent waiting to be (re)joined
     subtree_size: jax.Array  # i32[N]  peers in own subtree incl. self
     q: jax.Array             # i32[N, Q] inbound message ring
+    q_when: jax.Array        # i32[N, Q] earliest step each entry may be popped
+                             # (the queued-arrival stamp of per-edge latency;
+                             # entries pop in FIFO order, so a delayed head
+                             # blocks the queue exactly like in-order stream
+                             # delivery on the wire)
     q_head: jax.Array        # i32[N]
     q_len: jax.Array         # i32[N]
     out: jax.Array           # i32[N, OC] delivered-message ring (client.out analog)
     out_len: jax.Array       # i32[N]  total delivered (monotonic)
     out_drained: jax.Array   # i32[N]  host-consumed count (backpressure boundary)
+    edge_delay: jax.Array    # i32[N, W] extra steps a message spends crossing
+                             # the (parent, child-slot) edge (0 = the default
+                             # one-hop-per-step fabric)
+    edge_drop: jax.Array     # f32[N, W] per-message drop probability on the
+                             # edge (lossy link, NOT death: no write error, no
+                             # repair — v0-style silent loss)
+    key: jax.Array           # u32[2] PRNG key for edge-drop draws
     root: jax.Array          # i32[]   topic root peer
     width: jax.Array         # i32[]   steady-state fanout (TreeWidth)
     max_width: jax.Array     # i32[]   priority fanout (TreeMaxWidth)
     step_num: jax.Array      # i32[]
 
 
-def init_state(params: SimParams, opts: TreeOpts, root: int = 0) -> TreeState:
+# Field-name sharding classification for the peer-dimension parallel path
+# (see parallel/mesh.py): every field is per-peer (leading dim N) except
+# these.  By NAME, not shape, so a non-peer array (like the [2] PRNG key)
+# can never be silently sharded — adding a TreeState field forces a
+# decision here (parallel.mesh.state_shardings errors on unclassified
+# non-peer leaves).
+TREE_REPLICATED_FIELDS = frozenset(
+    {"key", "root", "width", "max_width", "step_num"}
+)
+
+
+def init_state(
+    params: SimParams, opts: TreeOpts, root: int = 0, seed: int = 0
+) -> TreeState:
     if params.max_width < opts.tree_max_width:
         raise ValueError(
             f"SimParams.max_width ({params.max_width}) must be >= "
@@ -100,11 +125,15 @@ def init_state(params: SimParams, opts: TreeOpts, root: int = 0) -> TreeState:
         join_wait=jnp.zeros((n,), i32),
         subtree_size=jnp.zeros((n,), i32).at[root].set(1),
         q=jnp.full((n, params.queue_cap), NO_MSG, i32),
+        q_when=jnp.zeros((n, params.queue_cap), i32),
         q_head=jnp.zeros((n,), i32),
         q_len=jnp.zeros((n,), i32),
         out=jnp.full((n, params.out_cap), NO_MSG, i32),
         out_len=jnp.zeros((n,), i32),
         out_drained=jnp.zeros((n,), i32),
+        edge_delay=jnp.zeros((n, w), i32),
+        edge_drop=jnp.zeros((n, w), jnp.float32),
+        key=jax.random.PRNGKey(seed),
         root=jnp.asarray(root, i32),
         width=jnp.asarray(opts.tree_width, i32),
         max_width=jnp.asarray(opts.tree_max_width, i32),
@@ -151,6 +180,25 @@ def begin_subscribe_many(st: TreeState, peers_mask: jax.Array) -> TreeState:
 
 
 @jax.jit
+def set_link_profile(
+    st: TreeState, delay: jax.Array, drop_prob: jax.Array
+) -> TreeState:
+    """Install per-edge latency/drop tensors (SURVEY §2.3: the mocknet
+    analog's "per-edge latency/drop tensors", ``pubsub_test.go:18-25``).
+
+    ``delay`` i32[N, W]: extra lockstep rounds a message spends crossing the
+    (parent, child-slot) edge.  ``drop_prob`` f32[N, W]: probability each
+    forwarded copy is silently lost on that edge.  Both address edges by the
+    parent's child SLOT, so a profile describes links, and repair rewires
+    which peer sits behind a link.  Zeroes restore the ideal fabric.
+    """
+    return st._replace(
+        edge_delay=delay.astype(jnp.int32),
+        edge_drop=drop_prob.astype(jnp.float32),
+    )
+
+
+@jax.jit
 def publish_many(st: TreeState, msg_ids: jax.Array) -> TreeState:
     """Enqueue a batch of messages at the root (ids >= 0; NO_MSG entries
     skipped).  Caller is responsible for queue capacity."""
@@ -161,7 +209,11 @@ def publish_many(st: TreeState, msg_ids: jax.Array) -> TreeState:
     tails = (st.q_head[r] + st.q_len[r] + offsets) % qcap
     rows = jnp.where(valid, r, st.q.shape[0])
     q = st.q.at[rows, tails].set(msg_ids, mode="drop")
-    return st._replace(q=q, q_len=st.q_len.at[r].add(valid.sum().astype(jnp.int32)))
+    q_when = st.q_when.at[rows, tails].set(st.step_num, mode="drop")
+    return st._replace(
+        q=q, q_when=q_when,
+        q_len=st.q_len.at[r].add(valid.sum().astype(jnp.int32)),
+    )
 
 
 @jax.jit
@@ -192,6 +244,7 @@ def publish(st: TreeState, msg_id: jax.Array) -> TreeState:
     tail = (st.q_head[r] + st.q_len[r]) % st.q.shape[1]
     return st._replace(
         q=st.q.at[r, tail].set(msg_id),
+        q_when=st.q_when.at[r, tail].set(st.step_num),
         q_len=st.q_len.at[r].add(1),
     )
 
@@ -367,6 +420,16 @@ def _phase_data(st: TreeState):
     Writes to dead children are dropped and flagged, like the write-error path
     in ``forwardMessage`` (``subtree.go:333-336``).
 
+    Per-edge network modelling (SURVEY §2.3, set via ``set_link_profile``):
+    a forwarded copy is stamped poppable at ``now + 1 + edge_delay[i, s]``
+    (queued-arrival semantics; the head entry gates the FIFO, which is
+    in-order stream delivery), and is silently lost with probability
+    ``edge_drop[i, s]`` — a lossy link, distinct from death: no write error
+    is surfaced, so no repair triggers (v0-style accepted loss).  Control
+    traffic (join/redirect/Part/State) stays instantaneous: the parity
+    contracts key on data-plane loss windows, and a delayed control plane
+    would only widen convergence, not change loss classes.
+
     Returns (state, dead_detect bool[N, W]).
     """
     n, w = st.children.shape
@@ -380,8 +443,13 @@ def _phase_data(st: TreeState):
     child_room = jnp.where(ch_ok, ch_qlen < qcap, True).all(axis=1)
     out_room = is_root | ((st.out_len - st.out_drained) < oc)
 
-    popper = st.alive & st.joined & (st.q_len > 0) & out_room & child_room
-    msg = st.q[jnp.arange(n), st.q_head % qcap]
+    rows = jnp.arange(n)
+    head_ready = st.q_when[rows, st.q_head % qcap] <= st.step_num
+    popper = (
+        st.alive & st.joined & (st.q_len > 0) & head_ready
+        & out_room & child_room
+    )
+    msg = st.q[rows, st.q_head % qcap]
     q_head = jnp.where(popper, (st.q_head + 1) % qcap, st.q_head)
     q_len = jnp.where(popper, st.q_len - 1, st.q_len)
 
@@ -394,16 +462,23 @@ def _phase_data(st: TreeState):
 
     # Forward: scatter msg into each live child's queue tail.  Each child has
     # exactly one parent, so targets are unique — no write conflicts.
+    key, kdrop = jax.random.split(st.key)
+    lost = jax.random.uniform(kdrop, (n, w)) < st.edge_drop
     fwd = popper[:, None] & (st.children >= 0)
-    fwd_live = fwd & ch_ok
+    fwd_live = fwd & ch_ok & ~lost
     cidx = jnp.where(fwd_live, st.children, n).reshape(-1)
     ctail = (safe_gather(q_head, cidx, 0) + safe_gather(q_len, cidx, 0)) % qcap
     q = st.q.at[cidx, ctail].set(jnp.repeat(msg, w), mode="drop")
+    arrive = (st.step_num + 1 + st.edge_delay).reshape(-1)
+    q_when = st.q_when.at[cidx, ctail].set(arrive, mode="drop")
     q_len = q_len.at[cidx].add(jnp.where(cidx < n, 1, 0), mode="drop")
 
     dead_detect = fwd & ~ch_ok  # write failure -> repair in phase D
     return (
-        st._replace(q=q, q_head=q_head, q_len=q_len, out=out, out_len=out_len),
+        st._replace(
+            q=q, q_when=q_when, q_head=q_head, q_len=q_len, out=out,
+            out_len=out_len, key=key,
+        ),
         dead_detect,
     )
 

@@ -9,11 +9,12 @@ and the reverse-index gathers become all-to-all / collective-permute traffic
 on ICI — peers on different shards exchanging message words is the array
 form of cross-host streams.
 
-Why this module exists instead of reusing ``mesh.state_shardings`` directly:
 ``GossipState`` mixes peer-dim arrays ([N, ...]: adjacency, windows, scores)
 with message-window arrays ([M] metadata) and scalars; only dim-0==N arrays
-shard, the rest replicate.  The generic helper would shard anything with a
-leading dim.
+shard, the rest replicate.  The field classification below names BOTH sets
+exhaustively so an unclassified new field is an error (this module's
+original contribution, since generalized into ``mesh.state_shardings``'s
+``replicated=`` path, which this module now delegates to).
 
 The sharded path uses the portable jnp kernels (``ops/gossip_packed``) —
 ``use_pallas=False`` is forced; a pallas_call does not partition under GSPMD
@@ -44,7 +45,8 @@ _PEER_DIM_FIELDS = frozenset({
     "nbrs", "rev", "nbr_valid", "outbound", "alive", "subscribed",
     "edge_live", "nbr_sub", "mesh", "fanout", "fanout_age", "backoff",
     "counters", "gcounters", "scores", "have_w", "fresh_w",
-    "gossip_pend_w", "iwant_pend_w", "gossip_mute", "first_step",
+    "gossip_pend_w", "iwant_pend_w", "gossip_mute", "gossip_delay",
+    "pend_hold", "first_step",
 })
 _REPLICATED_FIELDS = frozenset({
     "msg_valid", "msg_birth", "msg_active", "msg_used", "key", "step",
@@ -55,7 +57,13 @@ def gossip_state_shardings(
     st: GossipState, mesh: Mesh, n_peers: int, axis: str = PEER_AXIS
 ):
     """NamedSharding pytree for a ``GossipState``: arrays with a leading
-    peer dim shard over ``axis``; message metadata and scalars replicate."""
+    peer dim shard over ``axis``; message metadata and scalars replicate.
+
+    Validates the exhaustive field classification above (an unclassified
+    field is an error) and that every peer-dim leaf really has leading dim
+    ``n_peers``, then delegates spec construction to the generalized
+    ``mesh.state_shardings`` replicated-by-name path.
+    """
     n_dev = mesh.shape[axis]
     if n_peers % n_dev != 0:
         raise ValueError(
@@ -67,23 +75,17 @@ def gossip_state_shardings(
             f"GossipState fields without a sharding rule: "
             f"{sorted(unclassified)}; classify them in gossip_sharded.py"
         )
+    for name in _PEER_DIM_FIELDS:
+        for leaf in jax.tree.leaves(getattr(st, name)):
+            if getattr(leaf, "ndim", 0) < 1 or leaf.shape[0] != n_peers:
+                raise ValueError(
+                    f"peer-dim leaf {name} has shape "
+                    f"{getattr(leaf, 'shape', None)}, expected leading dim "
+                    f"{n_peers}"
+                )
+    from .mesh import state_shardings
 
-    def shard_peer_leaf(x):
-        if getattr(x, "ndim", 0) < 1 or x.shape[0] != n_peers:
-            raise ValueError(
-                f"peer-dim leaf has shape {getattr(x, 'shape', None)}, "
-                f"expected leading dim {n_peers}"
-            )
-        return NamedSharding(mesh, P(axis, *([None] * (x.ndim - 1))))
-
-    repl = NamedSharding(mesh, P())
-    return type(st)(**{
-        name: jax.tree.map(
-            shard_peer_leaf if name in _PEER_DIM_FIELDS else lambda x: repl,
-            getattr(st, name),
-        )
-        for name in st._fields
-    })
+    return state_shardings(st, mesh, axis, replicated=_REPLICATED_FIELDS)
 
 
 class ShardedGossipSub:

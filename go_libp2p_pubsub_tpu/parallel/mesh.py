@@ -57,10 +57,29 @@ def peer_dim_spec(x: Any, axis: str = PEER_AXIS) -> P:
     return P(axis, *([None] * (ndim - 1)))
 
 
-def state_shardings(state: Any, mesh: Mesh, axis: str = PEER_AXIS):
+def state_shardings(
+    state: Any,
+    mesh: Mesh,
+    axis: str = PEER_AXIS,
+    replicated: frozenset = frozenset(),
+):
     """NamedSharding pytree matching ``state``: peer-dim arrays sharded,
-    scalars replicated.  Peer-dim sizes must divide the mesh size."""
+    scalars replicated.  Peer-dim sizes must divide the mesh size.
+
+    For NamedTuple states, ``replicated`` names the non-scalar fields that
+    must NOT shard (e.g. a PRNG key) — classification by field name, not
+    shape, so a non-peer array can never be silently sharded (the rule
+    ``gossip_sharded`` pioneered for ``GossipState``).  Pass the set defined
+    next to the state type (``ops.tree.TREE_REPLICATED_FIELDS``).  Two
+    validations back the claim that misclassification cannot pass silently:
+    ``replicated`` names must all be real fields (typos error), and every
+    non-replicated non-scalar leaf must share one leading (peer) dimension —
+    a forgotten classification of a non-peer array (a [2] PRNG key, an [M]
+    message-window table) fails the uniformity check on ANY device count,
+    not just when the divisibility happens to break.
+    """
     n = mesh.shape[axis]
+    repl = NamedSharding(mesh, P())
 
     def one(x):
         spec = peer_dim_spec(x, axis)
@@ -70,20 +89,64 @@ def state_shardings(state: Any, mesh: Mesh, axis: str = PEER_AXIS):
             )
         return NamedSharding(mesh, spec)
 
+    if hasattr(state, "_fields"):
+        unknown = replicated - set(state._fields)
+        if unknown:
+            raise ValueError(
+                f"replicated names not in {type(state).__name__}: "
+                f"{sorted(unknown)}"
+            )
+        peer_dims = {
+            leaf.shape[0]
+            for name in state._fields
+            if name not in replicated
+            for leaf in jax.tree.leaves(getattr(state, name))
+            if getattr(leaf, "ndim", 0) >= 1
+        }
+        if len(peer_dims) > 1:
+            raise ValueError(
+                f"non-replicated leaves of {type(state).__name__} disagree "
+                f"on the peer dimension ({sorted(peer_dims)}); classify the "
+                f"non-peer fields via `replicated=` (e.g. "
+                f"ops.tree.TREE_REPLICATED_FIELDS)"
+            )
+        return type(state)(**{
+            name: jax.tree.map(
+                (lambda x: repl) if name in replicated else one,
+                getattr(state, name),
+            )
+            for name in state._fields
+        })
+    if replicated:
+        raise ValueError(
+            "replicated field names given but state is not a NamedTuple"
+        )
     return jax.tree.map(one, state)
 
 
-def shard_state(state: Any, mesh: Mesh, axis: str = PEER_AXIS):
+def shard_state(
+    state: Any,
+    mesh: Mesh,
+    axis: str = PEER_AXIS,
+    replicated: frozenset = frozenset(),
+):
     """Place a host/single-device state onto the mesh, peer-dim sharded."""
-    return jax.device_put(state, state_shardings(state, mesh, axis))
+    return jax.device_put(state, state_shardings(state, mesh, axis, replicated))
 
 
-def sharded_fn(fn, mesh: Mesh, example_state: Any, axis: str = PEER_AXIS, **jit_kw):
+def sharded_fn(
+    fn,
+    mesh: Mesh,
+    example_state: Any,
+    axis: str = PEER_AXIS,
+    replicated: frozenset = frozenset(),
+    **jit_kw,
+):
     """jit ``fn(state) -> state`` with peer-sharded in/out shardings pinned.
 
     XLA GSPMD partitions the gathers/scatters of the step function across the
     mesh, inserting ICI collectives where peers on different shards exchange
     messages — the array analog of cross-host streams riding the network.
     """
-    sh = state_shardings(example_state, mesh, axis)
+    sh = state_shardings(example_state, mesh, axis, replicated)
     return jax.jit(fn, in_shardings=(sh,), out_shardings=sh, **jit_kw)

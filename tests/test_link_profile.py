@@ -1,0 +1,291 @@
+"""Per-edge latency/drop network modelling (SURVEY §2.3; r3 verdict item 3).
+
+The zero-latency fabric is the regime where lockstep and event-driven
+executions are trivially equivalent — these tests exercise the parity
+contracts with the link model ON: delays shift arrival steps without
+changing loss classes, and lossy links lose copies silently (no repair,
+unlike death).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu.api import SimNetwork, TopicManager
+from go_libp2p_pubsub_tpu.config import SimParams, TreeOpts
+from go_libp2p_pubsub_tpu.models.gossipsub import GossipSub
+from go_libp2p_pubsub_tpu.ops import tree as tree_ops
+
+
+def init_pubsub(net, hosts):
+    tms = [TopicManager(h) for h in hosts]
+    topic = tms[0].new_topic("foobar")
+    subchs = [tm.subscribe(hosts[0].id, "foobar") for tm in tms[1:]]
+    return topic, tms, subchs
+
+
+def check_system(topic, subs, skip=None, mid=0):
+    skip = skip or set()
+    mes = f"message number {mid}".encode()
+    topic.publish_message(mes)
+    for i, ch in enumerate(subs):
+        if i in skip:
+            continue
+        data = ch.get()
+        assert data == mes, f"wrong data on node {i}"
+
+
+def settle_and_clear(net, subs, steps=24):
+    net.step(steps)
+    for s in subs:
+        if not s.closed:
+            s.clear()
+
+
+# ---------------------------------------------------------------------------
+# parity loss windows hold under nonzero delay
+# ---------------------------------------------------------------------------
+
+
+def test_basic_pubsub_parity_under_delay():
+    """TestBasicPubsub's contract holds on a fabric where EVERY edge has
+    latency 1 (each hop takes 2 rounds): exact bytes, everyone delivers."""
+    net = SimNetwork(SimParams(max_peers=8))
+    hosts = net.make_hosts(4)
+    topic, _, subchs = init_pubsub(net, hosts)
+    net.set_link_profile(
+        np.ones((8, 8), np.int32), np.zeros((8, 8), np.float32)
+    )
+    for i in range(10):
+        check_system(topic, subchs, None, i)
+
+
+def test_nodes_dropping_parity_under_delay():
+    """TestNodesDropping's loss-window contract holds with per-edge latency
+    on: loss stays scoped to the killed subtree, recovery is complete."""
+    net = SimNetwork(SimParams(max_peers=8))
+    hosts = net.make_hosts(4)
+    topic, _, subchs = init_pubsub(net, hosts)
+    rng = np.random.default_rng(0)
+    delays = rng.integers(0, 3, (8, 8)).astype(np.int32)  # heterogeneous
+    net.set_link_profile(delays, np.zeros((8, 8), np.float32))
+
+    check_system(topic, subchs, None, 0)
+    hosts[1].close()  # abrupt: no Part
+    check_system(topic, subchs, {0, 2}, 1)
+    settle_and_clear(net, subchs)
+    for i in range(10):
+        check_system(topic, subchs, {0}, i + 100)
+
+
+def test_nodes_dropping_gracefully_parity_under_delay():
+    """Graceful-leave contract under latency: only the departed node misses
+    messages, before and after."""
+    net = SimNetwork(SimParams(max_peers=8))
+    hosts = net.make_hosts(4)
+    topic, _, subchs = init_pubsub(net, hosts)
+    net.set_link_profile(
+        np.full((8, 8), 2, np.int32), np.zeros((8, 8), np.float32)
+    )
+    check_system(topic, subchs, None, 0)
+    subchs[0].close()
+    net.step(8)
+    check_system(topic, subchs, {0}, 1)
+    settle_and_clear(net, subchs)
+    for i in range(10):
+        check_system(topic, subchs, {0}, i + 100)
+
+
+# ---------------------------------------------------------------------------
+# delay semantics: in-flight, scoped, eventually delivered
+# ---------------------------------------------------------------------------
+
+
+def test_delay_scopes_lag_to_delayed_subtree():
+    """A slow edge delays ONLY the subtree hanging below it: siblings on
+    fast edges deliver rounds earlier; the slow subtree delivers later, not
+    never."""
+    params = SimParams(max_peers=8, max_width=8)
+    st = tree_ops.init_state(params, TreeOpts(tree_width=4), root=0)
+    st = tree_ops.begin_subscribe_many(st, jnp.arange(8) < 4)
+    for _ in range(8):
+        st = tree_ops.step(st)
+    assert int(st.joined[:4].sum()) == 4
+    # Width 4: peers 1..3 are all direct children of the root.  Find peer
+    # 1's slot and put 5 steps of latency on exactly that edge.
+    children = np.asarray(st.children)
+    slot = int(np.where(children[0] == 1)[0][0])
+    delay = np.zeros((8, 8), np.int32)
+    delay[0, slot] = 5
+    st = tree_ops.set_link_profile(
+        st, jnp.asarray(delay), jnp.zeros((8, 8), jnp.float32)
+    )
+
+    st = tree_ops.publish(st, jnp.int32(0))
+    for _ in range(2):
+        st = tree_ops.step(st)
+    out_len = np.asarray(st.out_len)
+    assert out_len[2] == 1 and out_len[3] == 1, "fast siblings deliver"
+    assert out_len[1] == 0, "slow edge still in flight"
+    for _ in range(5):
+        st = tree_ops.step(st)
+    assert int(np.asarray(st.out_len)[1]) == 1, "delayed, not lost"
+    # Repair never triggered: the tree shape is intact.
+    assert int(np.asarray(st.parent)[1]) == 0
+
+
+def test_drop_prob_one_loses_copies_without_repair():
+    """drop_prob=1 on one edge silently loses every copy crossing it — the
+    v0 loss class (no write error, no repair, subtree stays attached)."""
+    params = SimParams(max_peers=8, max_width=8)
+    st = tree_ops.init_state(params, TreeOpts(tree_width=4), root=0)
+    st = tree_ops.begin_subscribe_many(st, jnp.arange(8) < 4)
+    for _ in range(8):
+        st = tree_ops.step(st)
+    children = np.asarray(st.children)
+    slot = int(np.where(children[0] == 1)[0][0])
+    drop = np.zeros((8, 8), np.float32)
+    drop[0, slot] = 1.0
+    st = tree_ops.set_link_profile(
+        st, jnp.zeros((8, 8), jnp.int32), jnp.asarray(drop)
+    )
+
+    for m in range(3):
+        st = tree_ops.publish(st, jnp.int32(m))
+    for _ in range(12):
+        st = tree_ops.step(st)
+    out_len = np.asarray(st.out_len)
+    assert out_len[2] == 3 and out_len[3] == 3, "clean edges deliver all"
+    assert out_len[1] == 0, "lossy edge loses every copy"
+    # No repair: peer 1 still attached under the root (loss != death).
+    assert int(np.asarray(st.parent)[1]) == 0
+    assert bool(np.asarray(st.joined)[1])
+
+
+def test_fractional_drop_loses_some_not_all():
+    """drop_prob=0.5 over many messages: some lost, some delivered on the
+    lossy edge; clean edges lose nothing (per-copy independence)."""
+    params = SimParams(max_peers=8, max_width=8, queue_cap=64, out_cap=64)
+    st = tree_ops.init_state(params, TreeOpts(tree_width=4), root=0, seed=3)
+    st = tree_ops.begin_subscribe_many(st, jnp.arange(8) < 4)
+    for _ in range(8):
+        st = tree_ops.step(st)
+    children = np.asarray(st.children)
+    slot = int(np.where(children[0] == 1)[0][0])
+    drop = np.zeros((8, 8), np.float32)
+    drop[0, slot] = 0.5
+    st = tree_ops.set_link_profile(
+        st, jnp.zeros((8, 8), jnp.int32), jnp.asarray(drop)
+    )
+    n_msgs = 32
+    st = tree_ops.publish_many(st, jnp.arange(n_msgs, dtype=jnp.int32))
+    st = tree_ops.run_steps(st, n_msgs + 8)
+    out_len = np.asarray(st.out_len)
+    assert out_len[2] == n_msgs and out_len[3] == n_msgs
+    assert 0 < out_len[1] < n_msgs, f"expected partial loss, got {out_len[1]}"
+
+
+# ---------------------------------------------------------------------------
+# gossip plane: ingress delay mirrored in the pend fold
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_gossip_ingress_delay_defers_pend_arrivals():
+    """A peer reachable ONLY via gossip (mesh edges carved, score pinned
+    below graft but above gossip thresholds) receives exactly
+    ``gossip_delay`` rounds later than on the ideal fabric — the eager mesh
+    plane is untouched by the link model, so two otherwise-identical runs
+    (same seed, same PRNG stream) differ only in that peer's arrival step.
+    """
+    victim = 5
+
+    def run_once(delay_rounds):
+        gs = GossipSub(n_peers=64, n_slots=16, conn_degree=8, msg_window=8,
+                       use_pallas=False)
+        st = gs.init(seed=7)
+        # Pin the victim's app score between the graft gate (>= 0) and the
+        # gossip threshold (-10): nobody meshes with it, everyone still
+        # advertises to it.
+        app = jnp.zeros((gs.n,), jnp.float32).at[victim].set(-5.0)
+        st = st._replace(gcounters=st.gcounters._replace(app_score=app))
+        # Carve existing mesh edges both ways.
+        mesh = np.asarray(st.mesh).copy()
+        nbrs, rev = np.asarray(st.nbrs), np.asarray(st.rev)
+        for s in range(gs.k):
+            if mesh[victim, s]:
+                mesh[nbrs[victim, s], rev[victim, s]] = False
+                mesh[victim, s] = False
+        st = st._replace(mesh=jnp.asarray(mesh))
+        if delay_rounds:
+            st = gs.set_gossip_delay(
+                st, jnp.zeros((gs.n,), jnp.int32).at[victim].set(delay_rounds)
+            )
+        st = gs.publish(st, jnp.int32(0), jnp.int32(0), jnp.asarray(True))
+        st = gs.run(st, 6 * gs.heartbeat_steps)
+        return int(np.asarray(st.first_step)[victim, 0])
+
+    s0 = run_once(0)
+    s3 = run_once(3)
+    assert s0 >= 0 and s3 >= 0, "victim must eventually receive via gossip"
+    assert s3 == s0 + 3, f"ingress delay must defer arrival: {s0} -> {s3}"
+
+
+@pytest.mark.slow
+def test_sustained_traffic_does_not_starve_delayed_peer():
+    """Publishing into a delayed peer EVERY round must not defer its pend
+    fold forever (regression: publish re-armed the hold with max(hold,
+    delay) on each offer, so steady traffic turned delay d into delay
+    infinity).  The hold arms once per idle batch; later arrivals join it."""
+    gs = GossipSub(n_peers=32, n_slots=8, conn_degree=4, msg_window=32,
+                   use_pallas=False)
+    st = gs.init(seed=5)
+    victim = 9
+    # Victim reachable only via pend arrivals: carve mesh, pin score between
+    # graft (>=0) and publish (-50) thresholds.
+    app = jnp.zeros((gs.n,), jnp.float32).at[victim].set(-5.0)
+    st = st._replace(gcounters=st.gcounters._replace(app_score=app))
+    mesh = np.asarray(st.mesh).copy()
+    nbrs, rev = np.asarray(st.nbrs), np.asarray(st.rev)
+    for s in range(gs.k):
+        if mesh[victim, s]:
+            mesh[nbrs[victim, s], rev[victim, s]] = False
+            mesh[victim, s] = False
+    st = st._replace(mesh=jnp.asarray(mesh))
+    st = gs.set_gossip_delay(
+        st, jnp.zeros((gs.n,), jnp.int32).at[victim].set(2)
+    )
+    # A direct neighbor publishes every round: each flood offer lands in the
+    # victim's pend row while its hold is counting.
+    publisher = int(nbrs[victim][np.asarray(st.nbr_valid)[victim]][0])
+    for r in range(12):
+        st = gs.publish(
+            st, jnp.int32(publisher), jnp.int32(r), jnp.asarray(True)
+        )
+        st = gs.run(st, 1)
+    st = gs.run(st, 8)
+    first = np.asarray(st.first_step)[victim, :12]
+    assert (first >= 0).all(), (
+        f"delayed peer starved under sustained traffic: first_step {first}"
+    )
+
+
+@pytest.mark.slow
+def test_gossip_delay_zero_is_bitwise_identical():
+    """The delay machinery with an all-zero profile must not change a single
+    bit of a rollout (the ideal fabric is the delay-0 special case)."""
+    gs = GossipSub(n_peers=32, n_slots=8, conn_degree=4, msg_window=8,
+                   use_pallas=False)
+    st_a = gs.init(seed=1)
+    st_b = gs.set_gossip_delay(st_a, jnp.zeros((32,), jnp.int32))
+    for s in range(4):
+        st_a = gs.publish(st_a, jnp.int32(s), jnp.int32(s), jnp.asarray(True))
+        st_b = gs.publish(st_b, jnp.int32(s), jnp.int32(s), jnp.asarray(True))
+    st_a = gs.run(st_a, 20)
+    st_b = gs.run(st_b, 20)
+    np.testing.assert_array_equal(
+        np.asarray(st_a.have_w), np.asarray(st_b.have_w)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(st_a.first_step), np.asarray(st_b.first_step)
+    )
