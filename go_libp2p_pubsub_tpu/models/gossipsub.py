@@ -499,7 +499,7 @@ class GossipSub:
 
     def _heartbeat(self, st: GossipState) -> GossipState:
         p, sp = self.params, self.score_params
-        khb, kgossip, kfan, kpx, knext = jax.random.split(st.key, 5)
+        khb, kgossip, kiwant, kfan, kpx, knext = jax.random.split(st.key, 6)
 
         # Advance mesh clocks by one heartbeat interval; decay; re-score.
         c = scoring_ops.tick_mesh_clocks(st.counters, st.mesh, p.heartbeat_interval_s)
@@ -579,11 +579,15 @@ class GossipSub:
             sp.gossip_threshold,
         )
         # An advertiser serves unless it is a promise-breaker (gossip_mute)
-        # — death is already excluded by edge_live in the selection.
+        # — death is already excluded by edge_live in the selection.  The
+        # receiver ignores IHAVEs from advertisers it scores below
+        # gossip_threshold (go's handleIHave gate) and draws the ask target
+        # in keyed random slot order, so a low-slot promise-breaker cannot
+        # permanently starve ids an honest advertiser also offers.
         serve_ok = ~safe_gather(st.gossip_mute, px.nbrs, True)
         iwant_pend_w, broken = gossip_ops.iwant_select_packed(
-            adv_w, have_w, edge_live & nbr_sub, serve_ok, part,
-            p.max_iwant_length,
+            kiwant, adv_w, have_w, edge_live & nbr_sub, scores, serve_ok,
+            part, p.max_iwant_length, sp.gossip_threshold,
         )
         # P7: broken promises charge the ADVERTISER (indexed by remote id).
         promise_ids = jnp.where(

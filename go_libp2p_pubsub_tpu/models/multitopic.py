@@ -348,7 +348,7 @@ class MultiTopicGossipSub:
         ]
         scores = jnp.where(st.nbr_valid, tsc.sum(axis=0) + remote, -jnp.inf)
 
-        keys4 = jax.vmap(lambda k: jax.random.split(k, 4))(st.keys)
+        keys5 = jax.vmap(lambda k: jax.random.split(k, 5))(st.keys)
         topic_alive = self._topic_alive(st)
         hb_idx = st.step // self.heartbeat_steps
         do_og = (hb_idx % p.opportunistic_graft_ticks) == 0
@@ -365,8 +365,8 @@ class MultiTopicGossipSub:
         serve_ok = ~_safe_gather(st.gossip_mute, st.nbrs, True)
 
         def one(mesh_t, fan_t, fage_t, bo_t, c_t, have_t, pend_t, mv, ma,
-                mbirth, mused, k4, al, el, sub_t):
-            khb, kgossip, kfan, knext = k4
+                mbirth, mused, k5, al, el, sub_t):
+            khb, kgossip, kiwant, kfan, knext = k5
             new_mesh, grafted, pruned, bo2, bo_viol = heartbeat_mesh(
                 khb, mesh_t, scores, st.nbrs, st.rev, el, al, p, bo_t,
                 st.outbound, do_og,
@@ -389,9 +389,11 @@ class MultiTopicGossipSub:
                 bitpack.pack(mv & ma & gossip_age_ok), p, sp.gossip_threshold,
             )
             # IWANT grant + promise accounting (see the single-topic
-            # heartbeat): transfers land two rounds out via iwant_pend_w.
+            # heartbeat): transfers land two rounds out via iwant_pend_w,
+            # score-gated and randomly prioritized like the single-topic path.
             iwant_t, broken_t = gossip_ops.iwant_select_packed(
-                adv, have2, el, serve_ok, al, p.max_iwant_length
+                kiwant, adv, have2, el, scores, serve_ok, al,
+                p.max_iwant_length, sp.gossip_threshold,
             )
             # Fanout upkeep for this topic's non-subscribed publishers.
             fage2 = jnp.minimum(fage_t + 1, jnp.iinfo(jnp.int32).max // 2)
@@ -429,7 +431,7 @@ class MultiTopicGossipSub:
          keys, bo_viols, broken) = jax.vmap(one)(
             st.mesh, st.fanout, st.fanout_age, st.backoff, c, st.have_w,
             st.gossip_pend_w, st.msg_valid, st.msg_active, st.msg_birth,
-            st.msg_used, keys4, topic_alive, st.edge_live, st.subscribed,
+            st.msg_used, keys5, topic_alive, st.edge_live, st.subscribed,
         )
         # P7 is a GLOBAL component: backoff-violating GRAFTs and broken
         # gossip promises in ANY topic accrue to the sender's one

@@ -190,20 +190,45 @@ def ihave_advertise(
     return cap_ihave(adv, p.max_ihave_length)
 
 
+def iwant_priority(key: jax.Array, n: int, k: int) -> Tuple[jax.Array, jax.Array]:
+    """Per-heartbeat random advertiser priority -> (perm, inv), both i32[N, K].
+
+    ``perm[i]`` is a keyed random order of peer i's slots; ``inv`` is its
+    inverse.  Shared by the packed and unpacked IWANT kernels so the two
+    stay bit-exact under the same key.
+    """
+    r = jax.random.uniform(key, (n, k))
+    perm = jnp.argsort(r, axis=1).astype(jnp.int32)
+    inv = jnp.argsort(perm, axis=1).astype(jnp.int32)
+    return perm, inv
+
+
 def iwant_select(
+    key: jax.Array,
     adv: jax.Array,        # bool[N, K, M] advertisements received this heartbeat
     have: jax.Array,       # bool[N, M]
     edge_live: jax.Array,  # bool[N, K]
+    scores: jax.Array,     # f32[N, K] receiver's score of each advertiser
     serve_ok: jax.Array,   # bool[N, K] the advertiser will actually serve
     alive: jax.Array,      # bool[N]
     max_iwant_length: int,
+    gossip_threshold: float,
 ) -> Tuple[jax.Array, jax.Array]:
     """IWANT phase with promise accounting -> (pend bool[N, M],
     broken f32[N, K]).
 
-    Each peer asks ONE advertiser per wanted message — the first advertising
-    slot (go-gossipsub samples one peer per id; first-slot is the array
-    form), capped at ``max_iwant_length`` ids per advertiser per heartbeat
+    Two spec gates from go-gossipsub's handleIHave:
+
+    - IHAVEs from advertisers the receiver scores below ``gossip_threshold``
+      are ignored entirely (no ask, no promise) — so a promise-breaker whose
+      accrued P7 drags its score under the threshold loses its grip on the
+      pull path;
+    - the ask target per wanted id is drawn in a keyed RANDOM slot order
+      (go samples from shuffled order), not lowest-slot-first — a fixed
+      priority would let an adversary occupying a low slot absorb every ask
+      for ids an honest higher-slot peer also advertises.
+
+    Asks are capped at ``max_iwant_length`` ids per advertiser per heartbeat
     (go's MaxIHaveLength ask budget, word-granular like ``cap_ihave``).
 
     ``pend`` is what actually arrives (advertisers with ``serve_ok`` false —
@@ -215,9 +240,14 @@ def iwant_select(
 
     Unpacked reference for ``gossip_packed.iwant_select_packed``.
     """
-    want = adv & ~have[:, None, :] & edge_live[:, :, None]
-    prefix = jnp.cumsum(want.astype(jnp.int32), axis=1)
-    first = want & (prefix == 1)                       # one advertiser per id
+    n, k = edge_live.shape
+    accept = edge_live & (scores >= gossip_threshold)
+    want = adv & ~have[:, None, :] & accept[:, :, None]
+    perm, inv = iwant_priority(key, n, k)
+    want_p = jnp.take_along_axis(want, perm[:, :, None], axis=1)
+    prefix = jnp.cumsum(want_p.astype(jnp.int32), axis=1)
+    first_p = want_p & (prefix == 1)           # one advertiser per id, random order
+    first = jnp.take_along_axis(first_p, inv[:, :, None], axis=1)
     asked = cap_ihave(first, max_iwant_length)
     served = asked & serve_ok[:, :, None]
     pend = served.any(axis=1) & alive[:, None]

@@ -164,25 +164,36 @@ def ihave_advertise_packed(
 
 
 def iwant_select_packed(
+    key: jax.Array,
     adv_w: jax.Array,      # u32[N, K, W] advertisements received this heartbeat
     have_w: jax.Array,     # u32[N, W]
     edge_live: jax.Array,  # bool[N, K]
+    scores: jax.Array,     # f32[N, K] receiver's score of each advertiser
     serve_ok: jax.Array,   # bool[N, K] the advertiser will actually serve
     alive: jax.Array,      # bool[N]
     max_iwant_length: int,
+    gossip_threshold: float,
 ) -> tuple[jax.Array, jax.Array]:
     """IWANT phase with promise accounting over packed windows ->
     (pend u32[N, W], broken f32[N, K]).
 
-    Bit-exact with :func:`gossip.iwant_select` (see its docstring for the
-    protocol rules: one first-advertiser ask per id, word-granular
-    ``max_iwant_length`` budget per advertiser, broken-promise counts for
-    muted/dead advertisers).  The transfer lands via the caller's pend
-    fold — the advertiser's mcache retention (``history_length >
+    Bit-exact with :func:`gossip.iwant_select` under the same key (see its
+    docstring for the protocol rules: IHAVEs below ``gossip_threshold``
+    ignored, one ask per id at a keyed RANDOM advertiser priority,
+    word-granular ``max_iwant_length`` budget per advertiser, broken-promise
+    counts for muted/dead advertisers).  The transfer lands via the caller's
+    pend fold — the advertiser's mcache retention (``history_length >
     history_gossip``) guarantees an honest advertiser can still serve."""
-    want = adv_w & ~have_w[:, None, :] & _as_mask(edge_live)[:, :, None]
-    before = exclusive_or_scan(want, axis=1)
-    first = want & ~before                             # one advertiser per id
+    from .gossip import iwant_priority
+
+    n, k = edge_live.shape
+    accept = edge_live & (scores >= gossip_threshold)
+    want = adv_w & ~have_w[:, None, :] & _as_mask(accept)[:, :, None]
+    perm, inv = iwant_priority(key, n, k)
+    want_p = jnp.take_along_axis(want, perm[:, :, None], axis=1)
+    before = exclusive_or_scan(want_p, axis=1)
+    first_p = want_p & ~before                 # one advertiser per id, random order
+    first = jnp.take_along_axis(first_p, inv[:, :, None], axis=1)
     asked = cap_ihave_packed(first, max_iwant_length)
     served = asked & _as_mask(serve_ok)[:, :, None]
     pend = jax.lax.reduce(

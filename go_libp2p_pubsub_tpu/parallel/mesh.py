@@ -75,8 +75,11 @@ def state_shardings(
     ``replicated`` names must all be real fields (typos error), and every
     non-replicated non-scalar leaf must share one leading (peer) dimension —
     a forgotten classification of a non-peer array (a [2] PRNG key, an [M]
-    message-window table) fails the uniformity check on ANY device count,
-    not just when the divisibility happens to break.
+    message-window table) fails the uniformity check regardless of
+    divisibility, UNLESS its leading dim coincidentally equals the peer dim
+    (e.g. msg_window == n_peers), in which case it is silently sharded —
+    so classify every non-peer field explicitly rather than relying on the
+    check to catch omissions.
     """
     n = mesh.shape[axis]
     repl = NamedSharding(mesh, P())

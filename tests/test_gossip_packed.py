@@ -135,12 +135,14 @@ def test_two_phase_gossip_packed_matches_reference(seed):
     serve_ok = jnp.asarray(
         np.random.default_rng(seed + 99).random((n, k)) < 0.66
     )
+    kiw = jax.random.PRNGKey(seed + 7)
     ref_pend, ref_broken = ref_ops.iwant_select(
-        ref_adv, have, edge_live, serve_ok, alive, max_iwant_length=40
+        kiw, ref_adv, have, edge_live, scores, serve_ok, alive,
+        max_iwant_length=40, gossip_threshold=-0.5,
     )
     out_pend, out_broken = packed_ops.iwant_select_packed(
-        out_adv, bitpack.pack(have), edge_live, serve_ok, alive,
-        max_iwant_length=40,
+        kiw, out_adv, bitpack.pack(have), edge_live, scores, serve_ok, alive,
+        max_iwant_length=40, gossip_threshold=-0.5,
     )
     np.testing.assert_array_equal(
         np.asarray(bitpack.unpack(out_pend, m)), np.asarray(ref_pend)
@@ -223,3 +225,103 @@ def test_build_topology_fast_invariants():
     deg = valid.sum(axis=1)
     assert deg.mean() > degree * 0.7
     assert deg.max() <= k
+
+
+def _two_advertiser_fixture():
+    """4 peers; peer 0 has neighbors 1 (slot 0) and 2 (slot 1), both
+    advertising message id 0.  Returns packed adv + supporting masks."""
+    n, k, m = 4, 2, 32
+    adv = np.zeros((n, k, m), bool)
+    adv[0, 0, 0] = True
+    adv[0, 1, 0] = True
+    edge_live = np.zeros((n, k), bool)
+    edge_live[0, 0] = edge_live[0, 1] = True
+    have = np.zeros((n, m), bool)
+    alive = np.ones(n, bool)
+    serve_ok = np.ones((n, k), bool)
+    return (
+        bitpack.pack(jnp.asarray(adv)),
+        bitpack.pack(jnp.asarray(have)),
+        jnp.asarray(edge_live),
+        jnp.asarray(serve_ok),
+        jnp.asarray(alive),
+    )
+
+
+def test_iwant_ignores_below_threshold_advertisers():
+    """go's handleIHave gate: an IHAVE from an advertiser scored below
+    gossip_threshold is ignored entirely — no ask, no pend, and NO broken
+    promise (an ignored advertisement never became a promise)."""
+    adv_w, have_w, edge_live, serve_ok, alive = _two_advertiser_fixture()
+    scores = jnp.full(edge_live.shape, -20.0)  # both advertisers graylisted
+    pend, broken = packed_ops.iwant_select_packed(
+        jax.random.PRNGKey(0), adv_w, have_w, edge_live, scores,
+        ~jnp.asarray(serve_ok),  # even promise-breakers: still ignored
+        alive, max_iwant_length=40, gossip_threshold=-10.0,
+    )
+    assert not np.asarray(pend).any()
+    assert not np.asarray(broken).any()
+
+
+def test_iwant_random_priority_spreads_asks():
+    """With two advertisers for the same id, the keyed random priority must
+    ask EACH of them under some key — a fixed lowest-slot rule (the old
+    behavior) would let a low-slot promise-breaker absorb every ask."""
+    adv_w, have_w, edge_live, serve_ok, alive = _two_advertiser_fixture()
+    scores = jnp.zeros(edge_live.shape)
+    asked_slots = set()
+    for s in range(16):
+        # serve_ok False on both: pend stays empty, broken marks the ASKED slot.
+        _, broken = packed_ops.iwant_select_packed(
+            jax.random.PRNGKey(s), adv_w, have_w, edge_live, scores,
+            jnp.zeros_like(serve_ok), alive,
+            max_iwant_length=40, gossip_threshold=-10.0,
+        )
+        b = np.asarray(broken)[0]
+        assert b.sum() == 1.0  # exactly one advertiser asked per id
+        asked_slots.add(int(b.argmax()))
+    assert asked_slots == {0, 1}, f"asks never rotated: {asked_slots}"
+
+
+def test_muted_advertiser_loses_grip_via_score_gate():
+    """Model-level closure of the kernel gates: a gossip_mute adversary
+    accrues P7 for its broken promises, its score falls below
+    gossip_threshold, and from then on its IHAVEs are (mostly) ignored.
+
+    The accrual does not go to literal zero: P7 decays, so a gated peer's
+    score eventually recovers past the threshold, earns one more ask, and is
+    re-gated — the spec's intended equilibrium.  What the fix guarantees
+    (and the old fixed-priority kernel lacked: the advisor's scenario was a
+    low-slot mute peer re-asked EVERY heartbeat forever) is that the late
+    ask rate collapses relative to the early rate."""
+    from go_libp2p_pubsub_tpu.models.gossipsub import GossipSub
+
+    # conn_degree > D so non-mesh edges exist (gossip only flows there).
+    gs = GossipSub(n_peers=48, n_slots=16, conn_degree=12, msg_window=32,
+                   use_pallas=False)
+    st = gs.init(seed=2)
+    st = gs.set_gossip_mute(st, jnp.arange(gs.n) < 8)
+    rng = np.random.default_rng(0)
+    bp_deltas = []
+    prev = 0.0
+    slot = 0
+    for _ in range(20):
+        # Sustained traffic published TWO rounds before each heartbeat, so
+        # the ids are still mid-flight when IHAVEs go out — want-sets stay
+        # non-empty and asks to muted advertisers would repeat forever
+        # without the score gate.
+        st = gs.run(st, gs.heartbeat_steps - 2)
+        for _ in range(4):
+            st = gs.publish(st, jnp.int32(int(rng.integers(8, gs.n))),
+                            jnp.int32(slot % gs.m), jnp.asarray(True))
+            slot += 1
+        st = gs.run(st, 2)
+        cur = float(np.asarray(st.gcounters.behaviour_penalty)[:8].sum())
+        # decay shrinks bp between heartbeats; count only fresh accrual
+        bp_deltas.append(max(cur - prev, 0.0))
+        prev = cur
+    early, late = sum(bp_deltas[:5]), sum(bp_deltas[-5:])
+    assert early > 2.0, f"muted peers never accrued P7: {bp_deltas}"
+    assert late < 0.3 * early, (
+        f"asks to muted peers never tapered: deltas {bp_deltas}"
+    )

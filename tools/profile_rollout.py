@@ -112,8 +112,8 @@ def main():
             part, scores, gossip_w, p, sp.gossip_threshold)
         serve_ok = ~safe_gather(st.gossip_mute, st.nbrs, True)
         return gossip_ops.iwant_select_packed(
-            adv, st.have_w, st.edge_live & st.nbr_sub, serve_ok, part,
-            p.max_iwant_length)
+            key, adv, st.have_w, st.edge_live & st.nbr_sub, scores, serve_ok,
+            part, p.max_iwant_length, sp.gossip_threshold)
     timeit("  ihave+iwant_select fused", ihave_iwant)
 
 
