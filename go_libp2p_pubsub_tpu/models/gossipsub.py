@@ -80,6 +80,15 @@ class GossipState(NamedTuple):
                              # delay model mirrored into the pend fold;
                              # 0 = ideal fabric)
     pend_hold: jax.Array     # i32[N] countdown until the pend fold is ready
+    edge_delay: jax.Array    # i32[N, K] per-edge EAGER-path ingress latency:
+                             # extra rounds a copy spends crossing the edge
+                             # from nbrs[i, s] into i (the tree fabric's
+                             # edge_delay twin for the mesh plane; 0 = ideal)
+    fresh_hist: jax.Array    # u32[N, D, W] rolling history of each peer's
+                             # fresh planes (D = max_edge_delay + 1); a
+                             # delay-d edge reads its sender's plane from d
+                             # rounds back.  D == 0 (max_edge_delay == 0)
+                             # disables the machinery entirely
     first_step: jax.Array   # i32[N, M] first-receipt step, -1 = never
     msg_valid: jax.Array    # bool[M] validation verdict
     msg_birth: jax.Array    # i32[M] publish step
@@ -247,6 +256,7 @@ class GossipSub:
         use_pallas: Optional[bool] = None,
         builder=None,
         graft_spammers: Optional[np.ndarray] = None,
+        max_edge_delay: int = 0,
     ):
         self.n = n_peers
         self.k = n_slots
@@ -257,6 +267,12 @@ class GossipSub:
         self.score_params = score_params or ScoreParams()
         self.heartbeat_steps = heartbeat_steps
         self.builder = builder  # explicit topology builder (seed pinning)
+        # Static ceiling for per-edge eager-path delay (rounds).  0 keeps
+        # the ideal-fabric code path byte-for-byte (no history carried);
+        # > 0 carries a (max_edge_delay + 1)-plane fresh history per peer.
+        if max_edge_delay < 0:
+            raise ValueError("max_edge_delay must be >= 0")
+        self.max_edge_delay = max_edge_delay
         # Misbehaviour model (attack traces): bool[N] of peers that GRAFT
         # through their own prune-backoff window; their refused attempts
         # accrue the P7 behaviour penalty each heartbeat.  Constructor-bound
@@ -331,6 +347,11 @@ class GossipSub:
             gossip_mute=jnp.zeros((n,), bool),
             gossip_delay=jnp.zeros((n,), jnp.int32),
             pend_hold=jnp.zeros((n,), jnp.int32),
+            edge_delay=jnp.zeros((n, k), jnp.int32),
+            fresh_hist=jnp.zeros(
+                (n, self.max_edge_delay + 1 if self.max_edge_delay else 0, w),
+                jnp.uint32,
+            ),
             first_step=jnp.full((n, m), -1, jnp.int32),
             msg_valid=jnp.zeros((m,), bool),
             msg_birth=jnp.zeros((m,), jnp.int32),
@@ -442,9 +463,26 @@ class GossipSub:
         pend_hold = st.pend_hold.at[rows].set(
             jnp.where(arm, st.gossip_delay[rows_c], cur_hold), mode="drop"
         )
+        # Per-edge delay mode: the fresh history must mirror every fresh_w
+        # mutation — scrub the recycled slot from ALL planes (a stale plane
+        # bit would turn into a phantom delayed delivery of the NEW message)
+        # and stamp the publisher's bit into the CURRENT plane (the one
+        # delay-0 edges read next round), exactly as fresh_w itself got it.
+        fresh_hist = st.fresh_hist
+        if self.max_edge_delay:
+            dpl = self.max_edge_delay + 1
+            cur = jnp.mod(st.step - 1, dpl)
+            fresh_hist = fresh_hist & ~bm[None, None, :]
+            row = jax.lax.dynamic_index_in_dim(
+                fresh_hist[src], cur, axis=0, keepdims=False
+            )
+            # Unconditional like seed_message's fresh_w stamp (an invalid
+            # publish relays on the eager path so P4 blame can land).
+            fresh_hist = fresh_hist.at[src, cur].set(row | bm)
         return st._replace(
             have_w=have_w, fresh_w=fresh_w, gossip_pend_w=pend_w,
             iwant_pend_w=iwant_pend_w, pend_hold=pend_hold,
+            fresh_hist=fresh_hist,
             first_step=first_step, msg_valid=mv,
             msg_birth=mb, msg_active=ma, msg_used=mu, fanout=fanout,
             fanout_age=fanout_age, key=knext,
@@ -468,6 +506,28 @@ class GossipSub:
         ``set_link_profile`` delay (SURVEY §2.3); zeros restore the ideal
         one-round fabric."""
         return st._replace(gossip_delay=delay.astype(jnp.int32))
+
+    def set_edge_delay(self, st: GossipState, delay) -> GossipState:
+        """Install per-edge EAGER-path ingress latency (i32[N, K]: extra
+        rounds a copy spends crossing the edge from ``nbrs[i, s]`` into i).
+
+        The mesh-plane twin of the tree fabric's ``set_link_profile`` delay
+        (SURVEY §2.3, the mocknet analog): quantized to whole rounds,
+        addressed by the RECEIVER's slot so repair/PX rewiring changes which
+        peer sits behind a link, not the link's latency.  Requires the model
+        to be built with ``max_edge_delay >= max(delay)`` (the history depth
+        is a compile-time shape); zeros restore the ideal fabric.
+        """
+        delay = np.asarray(delay)
+        if delay.max(initial=0) > self.max_edge_delay:
+            raise ValueError(
+                f"edge delay {int(delay.max())} exceeds this model's "
+                f"max_edge_delay={self.max_edge_delay}; rebuild the model "
+                f"with a larger ceiling"
+            )
+        if delay.min(initial=0) < 0:
+            raise ValueError("edge delays must be >= 0")
+        return st._replace(edge_delay=jnp.asarray(delay, jnp.int32))
 
     @functools.partial(jax.jit, static_argnums=0)
     def set_gossip_mute(self, st: GossipState, mask: jax.Array) -> GossipState:
@@ -647,6 +707,11 @@ class GossipSub:
             scores=scores,
             have_w=have_w,
             gossip_pend_w=st.gossip_pend_w & ~dead_w[None, :],
+            # fresh_hist is deliberately NOT scrubbed here: the heartbeat
+            # does not touch fresh_w either, and the ideal model relays an
+            # expiry-raced fresh bit next round (stamping first_step and
+            # charging P4 via valid_w) — the history must mirror fresh_w's
+            # mutations exactly or the zero-delay bitwise identity breaks.
             iwant_pend_w=iwant_pend_w,
             msg_active=st.msg_active & ~expired,
             key=knext,
@@ -677,6 +742,19 @@ class GossipSub:
             st.scores >= self.score_params.graylist_threshold
         )
         valid_w = bitpack.pack(st.msg_valid & st.msg_active)
+        # Per-edge delay mode: each edge reads its sender's fresh plane from
+        # edge_delay[i, s] rounds back (plane (step-1-d) mod D of the rolling
+        # history) instead of the live fresh_w — one flattened row gather,
+        # same cost shape as the ideal fabric's fresh_w[nbrs].
+        if self.max_edge_delay:
+            dpl = self.max_edge_delay + 1
+            jrows = jnp.clip(st.nbrs, 0, self.n - 1)
+            plane = jnp.mod(st.step - 1 - st.edge_delay, dpl)
+            fresh_src = st.fresh_hist.reshape(self.n * dpl, self.w)[
+                jrows * dpl + plane
+            ]
+        else:
+            fresh_src = None
         if self.use_pallas:
             from ..ops.pallas_gossip import propagate_packed_pallas
 
@@ -684,11 +762,12 @@ class GossipSub:
                 relay_mesh, st.nbrs, st.edge_live, st.alive, have_w,
                 st.fresh_w, valid_w,
                 interpret=jax.default_backend() != "tpu",
+                fresh_src=fresh_src,
             )
         else:
             out = gossip_ops.propagate_packed(
                 relay_mesh, st.nbrs, st.edge_live, st.alive, have_w,
-                st.fresh_w, valid_w,
+                st.fresh_w, valid_w, fresh_src=fresh_src,
             )
         # One [N, M] stamping pass for both receipt sources (pend fold +
         # eager push): both record the same step, so the union stamps once.
@@ -716,10 +795,21 @@ class GossipSub:
             jnp.where(incoming, st.gossip_delay, 0),
             st.pend_hold - 1,
         )
+        new_fresh = out.fresh_w | gossip_new
+        fresh_hist = st.fresh_hist
+        if self.max_edge_delay:
+            # This round's fresh plane enters the rolling history at slot
+            # step mod D (the slot delay-0 edges read next round).
+            dpl = self.max_edge_delay + 1
+            fresh_hist = jax.lax.dynamic_update_slice(
+                st.fresh_hist, new_fresh[:, None, :],
+                (jnp.int32(0), jnp.mod(st.step, dpl), jnp.int32(0)),
+            )
         return st._replace(
             have_w=out.have_w,
             # Pend-fold arrivals relay on the NEXT round (one hop per round).
-            fresh_w=out.fresh_w | gossip_new,
+            fresh_w=new_fresh,
+            fresh_hist=fresh_hist,
             first_step=first_step,
             counters=c,
             gossip_pend_w=pend_next,

@@ -294,6 +294,10 @@ class MultiTopicGossipSub:
         ones_nk = jnp.ones((self.n, self.k), bool)
         inactive_age = jnp.full((self.n,), jnp.iinfo(jnp.int32).max // 2,
                                 jnp.int32)
+        # Per-edge eager delay is single-topic only (gs.max_edge_delay == 0):
+        # empty history + zero delays keep the ideal-fabric code path.
+        no_edge_delay = jnp.zeros((self.n, self.k), jnp.int32)
+        no_hist = jnp.zeros((self.n, 0, self.w), jnp.uint32)
 
         def one(mesh, fanout, backoff, counters, have_w, fresh_w, pend_w,
                 iwant_w, hold, first_step, mv, mb, ma, mu, key, al, el, sub):
@@ -305,7 +309,8 @@ class MultiTopicGossipSub:
                 gcounters=st.gcounters, scores=st.scores, have_w=have_w,
                 fresh_w=fresh_w, gossip_pend_w=pend_w, iwant_pend_w=iwant_w,
                 gossip_mute=st.gossip_mute, gossip_delay=st.gossip_delay,
-                pend_hold=hold, first_step=first_step,
+                pend_hold=hold, edge_delay=no_edge_delay, fresh_hist=no_hist,
+                first_step=first_step,
                 msg_valid=mv, msg_birth=mb, msg_active=ma, msg_used=mu,
                 key=key, step=st.step,
             )

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from ..config import GossipSubParams
+from .gossip import gossip_emission_mask, iwant_priority
 from .graphs import top_mask
 
 FULL = jnp.uint32(0xFFFFFFFF)
@@ -77,6 +78,8 @@ def propagate_packed(
     have_w: jax.Array,     # u32[N, W]
     fresh_w: jax.Array,    # u32[N, W]
     valid_w: jax.Array,    # u32[W]  packed (msg_valid & msg_active)
+    fresh_src=None,        # u32[N, K, W] pre-gathered per-edge sender planes
+                           # (per-edge delay mode); None -> fresh_w[nbrs]
 ) -> PropagatePackedOut:
     """One eager-push round over packed windows.
 
@@ -88,7 +91,8 @@ def propagate_packed(
 
     j = jnp.clip(nbrs, 0, n - 1)
     edge_ok = mesh & edge_live                                     # bool[N, K]
-    inc = _as_mask(edge_ok)[:, :, None] & fresh_w[j]               # u32[N, K, W]
+    src = fresh_w[j] if fresh_src is None else fresh_src
+    inc = _as_mask(edge_ok)[:, :, None] & src                      # u32[N, K, W]
 
     before = exclusive_or_scan(inc, axis=1)
     first_sender = inc & ~before
@@ -144,8 +148,6 @@ def ihave_advertise_packed(
     ``max_ihave_length`` cap).  The IWANT request and the transfer are the
     caller's next two propagate rounds — the wire protocol's two hops.
     """
-    from .gossip import gossip_emission_mask
-
     n, k = nbrs.shape
     d_lazy = min(p.d_lazy, k)
     if d_lazy <= 0:
@@ -184,8 +186,6 @@ def iwant_select_packed(
     counts for muted/dead advertisers).  The transfer lands via the caller's
     pend fold — the advertiser's mcache retention (``history_length >
     history_gossip``) guarantees an honest advertiser can still serve."""
-    from .gossip import iwant_priority
-
     n, k = edge_live.shape
     accept = edge_live & (scores >= gossip_threshold)
     want = adv_w & ~have_w[:, None, :] & _as_mask(accept)[:, :, None]

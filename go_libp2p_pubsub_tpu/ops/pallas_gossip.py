@@ -111,6 +111,8 @@ def propagate_packed_pallas(
     fresh_w: jax.Array,    # u32[N, W]
     valid_w: jax.Array,    # u32[W]
     interpret: bool = False,
+    fresh_src=None,        # u32[N, K, W] pre-gathered per-edge sender planes
+                           # (per-edge delay mode); None -> fresh_w[nbrs]
 ) -> PropagatePackedOut:
     """Drop-in replacement for ``gossip_packed.propagate_packed`` backed by
     the fused Pallas kernel.  ``interpret=True`` runs the kernel in the
@@ -123,7 +125,8 @@ def propagate_packed_pallas(
     edge_ok = mesh & edge_live
     # Gather + edge masking in one XLA fusion; [N, K, W] -> [N, K*W] is a
     # layout-preserving reshape of the gather output.
-    inc = jnp.where(edge_ok[:, :, None], fresh_w[j], jnp.uint32(0)).reshape(n, l)
+    src = fresh_w[j] if fresh_src is None else fresh_src
+    inc = jnp.where(edge_ok[:, :, None], src, jnp.uint32(0)).reshape(n, l)
     alive_m = _as_mask(alive)[:, None]
 
     pad = (-n) % TILE

@@ -46,7 +46,7 @@ _PEER_DIM_FIELDS = frozenset({
     "edge_live", "nbr_sub", "mesh", "fanout", "fanout_age", "backoff",
     "counters", "gcounters", "scores", "have_w", "fresh_w",
     "gossip_pend_w", "iwant_pend_w", "gossip_mute", "gossip_delay",
-    "pend_hold", "first_step",
+    "pend_hold", "edge_delay", "fresh_hist", "first_step",
 })
 _REPLICATED_FIELDS = frozenset({
     "msg_valid", "msg_birth", "msg_active", "msg_used", "key", "step",

@@ -89,3 +89,23 @@ def test_model_with_pallas_matches_reference_path():
     sb = b.run(sb, 12)
     for la, lb in zip(jax.tree.leaves(sa), jax.tree.leaves(sb)):
         np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_pallas_matches_reference_with_fresh_src():
+    """Per-edge delay mode feeds both kernels a pre-gathered [N, K, W]
+    sender-plane cube instead of the live fresh_w gather; they must stay
+    bit-exact on it."""
+    args = _state(4, 200)
+    n, k = args[1].shape
+    w = args[4].shape[1]
+    rng = np.random.default_rng(9)
+    fresh_src = jnp.asarray(
+        rng.integers(0, 2**32, (n, k, w), dtype=np.uint32)
+    )
+    ref = gossip_packed.propagate_packed(*args, fresh_src=fresh_src)
+    out = propagate_packed_pallas(*args, interpret=True, fresh_src=fresh_src)
+    np.testing.assert_array_equal(np.asarray(out.have_w), np.asarray(ref.have_w))
+    np.testing.assert_array_equal(np.asarray(out.fresh_w), np.asarray(ref.fresh_w))
+    np.testing.assert_array_equal(np.asarray(out.new_w), np.asarray(ref.new_w))
+    np.testing.assert_array_equal(np.asarray(out.fmd_inc), np.asarray(ref.fmd_inc))
+    np.testing.assert_array_equal(np.asarray(out.mmd_inc), np.asarray(ref.mmd_inc))
