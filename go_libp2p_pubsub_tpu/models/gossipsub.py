@@ -257,6 +257,7 @@ class GossipSub:
         builder=None,
         graft_spammers: Optional[np.ndarray] = None,
         max_edge_delay: int = 0,
+        pallas_shard_mesh=None,
     ):
         self.n = n_peers
         self.k = n_slots
@@ -280,15 +281,19 @@ class GossipSub:
         self.graft_spammers = (
             None if graft_spammers is None else jnp.asarray(graft_spammers)
         )
-        # Pallas fast path: unsharded TPU arrays only.  The jnp ops partition
-        # under GSPMD for the peer-sharded sim (see parallel/), while a
-        # pallas_call would need shard_map — sharded runners must pass
-        # use_pallas=False.  Mosaic lowering is TPU-only, so other backends
-        # auto-pick the jnp path; explicit True off-TPU runs the kernel in
-        # the Pallas interpreter (slow; test path).
+        # Pallas fast path.  A bare pallas_call does not partition under
+        # GSPMD, so the sharded runner historically forced use_pallas=False;
+        # passing ``pallas_shard_mesh`` (a jax.sharding.Mesh with a "peers"
+        # axis) instead routes the round through the shard_map-wrapped
+        # kernel (ops/pallas_gossip.propagate_packed_pallas_sharded), which
+        # all-gathers the fresh table over ICI and runs the fused kernel on
+        # each device's peer block.  Mosaic lowering is TPU-only, so other
+        # backends auto-pick the jnp path; explicit True off-TPU runs the
+        # kernel in the Pallas interpreter (slow; test path).
         if use_pallas is None:
             use_pallas = jax.default_backend() == "tpu"
         self.use_pallas = use_pallas
+        self.pallas_shard_mesh = pallas_shard_mesh
 
     def build_graph(self, seed: int = 0):
         """Connection topology only -> (nbrs, rev, nbr_valid, outbound) as
@@ -755,7 +760,17 @@ class GossipSub:
             ]
         else:
             fresh_src = None
-        if self.use_pallas:
+        if self.use_pallas and self.pallas_shard_mesh is not None:
+            from ..ops.pallas_gossip import propagate_packed_pallas_sharded
+
+            out = propagate_packed_pallas_sharded(
+                self.pallas_shard_mesh,
+                relay_mesh, st.nbrs, st.edge_live, st.alive, have_w,
+                st.fresh_w, valid_w,
+                interpret=jax.default_backend() != "tpu",
+                fresh_src=fresh_src,
+            )
+        elif self.use_pallas:
             from ..ops.pallas_gossip import propagate_packed_pallas
 
             out = propagate_packed_pallas(

@@ -78,6 +78,58 @@ class MultiTopicState(NamedTuple):
     step: jax.Array          # i32
 
 
+# Sharding classification of MultiTopicState for the peer-sharded multichip
+# path (parallel.mesh.state_shardings): per-topic leaves stack as [T, N, ...]
+# so their peer dim is axis 1; shared leaves lead with N; message metadata
+# and per-topic PRNG keys replicate.  Exhaustive by name — adding a field
+# without classifying it here fails multitopic_state_shardings.
+MULTITOPIC_REPLICATED_FIELDS = frozenset({
+    "msg_valid", "msg_birth", "msg_active", "msg_used", "keys", "step",
+})
+MULTITOPIC_PEER_DIMS = {
+    name: 1
+    for name in (
+        "subscribed", "edge_live", "mesh", "fanout", "fanout_age", "backoff",
+        "counters", "have_w", "fresh_w", "gossip_pend_w", "iwant_pend_w",
+        "pend_hold", "first_step",
+    )
+}
+_MT_PEER_DIM0_FIELDS = frozenset({
+    "nbrs", "rev", "nbr_valid", "outbound", "alive", "gcounters", "scores",
+    "gossip_mute", "gossip_delay",
+})
+
+
+def multitopic_state_shardings(st: MultiTopicState, mesh, n_peers: int):
+    """NamedSharding pytree for a ``MultiTopicState``: shared leaves shard
+    on dim 0, topic-stacked leaves on dim 1, metadata/keys replicate.
+    Validates the classification above is exhaustive first."""
+    from ..parallel.mesh import state_shardings
+
+    unclassified = (
+        set(st._fields) - MULTITOPIC_REPLICATED_FIELDS
+        - set(MULTITOPIC_PEER_DIMS) - _MT_PEER_DIM0_FIELDS
+    )
+    if unclassified:
+        raise ValueError(
+            f"MultiTopicState fields without a sharding rule: "
+            f"{sorted(unclassified)}; classify them in multitopic.py"
+        )
+    for name in _MT_PEER_DIM0_FIELDS | set(MULTITOPIC_PEER_DIMS):
+        d = MULTITOPIC_PEER_DIMS.get(name, 0)
+        for leaf in jax.tree.leaves(getattr(st, name)):
+            if getattr(leaf, "ndim", 0) <= d or leaf.shape[d] != n_peers:
+                raise ValueError(
+                    f"peer-dim leaf {name} has shape "
+                    f"{getattr(leaf, 'shape', None)}, expected dim {d} "
+                    f"== {n_peers}"
+                )
+    return state_shardings(
+        st, mesh, replicated=MULTITOPIC_REPLICATED_FIELDS,
+        peer_dim=MULTITOPIC_PEER_DIMS,
+    )
+
+
 class MultiTopicGossipSub:
     """T-topic GossipSub simulator sharing one connection graph."""
 
@@ -353,7 +405,7 @@ class MultiTopicGossipSub:
         ]
         scores = jnp.where(st.nbr_valid, tsc.sum(axis=0) + remote, -jnp.inf)
 
-        keys5 = jax.vmap(lambda k: jax.random.split(k, 5))(st.keys)
+        keys6 = jax.vmap(lambda k: jax.random.split(k, 6))(st.keys)
         topic_alive = self._topic_alive(st)
         hb_idx = st.step // self.heartbeat_steps
         do_og = (hb_idx % p.opportunistic_graft_ticks) == 0
@@ -370,8 +422,8 @@ class MultiTopicGossipSub:
         serve_ok = ~_safe_gather(st.gossip_mute, st.nbrs, True)
 
         def one(mesh_t, fan_t, fage_t, bo_t, c_t, have_t, pend_t, mv, ma,
-                mbirth, mused, k5, al, el, sub_t):
-            khb, kgossip, kiwant, kfan, knext = k5
+                mbirth, mused, k6, al, el, sub_t):
+            khb, kgossip, kiwant, kfan, kpx, knext = k6
             new_mesh, grafted, pruned, bo2, bo_viol = heartbeat_mesh(
                 khb, mesh_t, scores, st.nbrs, st.rev, el, al, p, bo_t,
                 st.outbound, do_og,
@@ -381,9 +433,12 @@ class MultiTopicGossipSub:
             c2 = scoring_ops.on_graft(
                 scoring_ops.on_prune(c_t, pruned, sp), grafted
             )
-            # PX is not run per topic: it rewires the SHARED connection
-            # layer, and T topics racing scatter-writes into one adjacency
-            # would break the slot pairing.  (Single-topic model runs it.)
+            # PX rewires the SHARED connection layer, so it cannot run
+            # inside this vmap (T topics racing scatter-writes into one
+            # adjacency would break the slot pairing); the heartbeat
+            # serializes it AFTER the vmap with a lax.scan over topics
+            # (see below).  This topic's pruned mask and PX key are
+            # returned for that pass.
             seen_expired = mused & (st.step - mbirth > seen_ttl_steps)
             have2 = have_t & ~bitpack.pack(seen_expired)
             gossip_age_ok = (
@@ -429,14 +484,14 @@ class MultiTopicGossipSub:
                 have2,
                 pend_t & ~dead_w[None, :],
                 iwant_t,
-                ma & ~expired, knext, bo_viol, broken_t,
+                ma & ~expired, knext, bo_viol, broken_t, pruned, kpx,
             )
 
         (mesh, fanout, fanout_age, backoff, c, have_w, pend, iwant_w, mactive,
-         keys, bo_viols, broken) = jax.vmap(one)(
+         keys, bo_viols, broken, pruned_t, kpx_t) = jax.vmap(one)(
             st.mesh, st.fanout, st.fanout_age, st.backoff, c, st.have_w,
             st.gossip_pend_w, st.msg_valid, st.msg_active, st.msg_birth,
-            st.msg_used, keys5, topic_alive, st.edge_live, st.subscribed,
+            st.msg_used, keys6, topic_alive, st.edge_live, st.subscribed,
         )
         # P7 is a GLOBAL component: backoff-violating GRAFTs and broken
         # gossip promises in ANY topic accrue to the sender's one
@@ -451,7 +506,44 @@ class MultiTopicGossipSub:
             + bo_viols.sum(axis=0)
             + promise_viol
         )
+
+        # Peer exchange on prune (v1.1 PX), serialized across topics: each
+        # topic's pruned peers may open one new connection toward a mesh
+        # neighbor of their pruner (``ops/px.py``'s conflict discipline
+        # holds within each call), and the scan threads the SHARED adjacency
+        # through the topics so no two topics race writes into one slot.
+        # Earlier topics win free slots first — spec-plausible (the wire has
+        # no cross-topic PX ordering either).  Gossip/IHAVE above ran on the
+        # pre-PX snapshot, a one-heartbeat lag a wire peer also sees.
+        from ..ops.px import px_rewire
+
+        def px_step(carry, xs):
+            nbrs_c, rev_c, nv_c, ob_c = carry
+            mesh_topic, pruned_topic, bo_topic, kpx = xs
+            px = px_rewire(
+                kpx, nbrs_c, rev_c, nv_c, ob_c, bo_topic, mesh_topic,
+                pruned_topic, scores, st.alive, sp.accept_px_threshold,
+            )
+            return (px.nbrs, px.rev, px.nbr_valid, px.outbound), (
+                px.backoff, px.connected
+            )
+
+        (nbrs2, rev2, nv2, ob2), (backoff, connected) = jax.lax.scan(
+            px_step, (st.nbrs, st.rev, st.nbr_valid, st.outbound),
+            (mesh, pruned_t, backoff, kpx_t),
+        )
+        # Per-topic liveness caches are regathered only when a PX edge
+        # actually formed (rare; the cond skips T gathers otherwise).
+        edge_live = jax.lax.cond(
+            connected.any(),
+            lambda: jax.vmap(compute_edge_live, (None, None, 0))(
+                nv2, nbrs2, st.alive[None, :] & st.subscribed
+            ),
+            lambda: st.edge_live,
+        )
         return st._replace(
+            nbrs=nbrs2, rev=rev2, nbr_valid=nv2, outbound=ob2,
+            edge_live=edge_live,
             mesh=mesh, fanout=fanout, fanout_age=fanout_age, backoff=backoff,
             counters=c, gcounters=g, scores=scores, have_w=have_w,
             gossip_pend_w=pend, iwant_pend_w=iwant_w, msg_active=mactive,

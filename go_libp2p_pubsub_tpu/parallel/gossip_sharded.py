@@ -16,9 +16,11 @@ exhaustively so an unclassified new field is an error (this module's
 original contribution, since generalized into ``mesh.state_shardings``'s
 ``replicated=`` path, which this module now delegates to).
 
-The sharded path uses the portable jnp kernels (``ops/gossip_packed``) —
-``use_pallas=False`` is forced; a pallas_call does not partition under GSPMD
-(it would need shard_map; see ``ops/pallas_gossip``).
+The sharded path defaults to the portable jnp kernels (``ops/gossip_packed``),
+which GSPMD partitions automatically; ``use_pallas=True`` instead routes the
+eager round through the ``shard_map``-wrapped fused TPU kernel
+(``ops/pallas_gossip.propagate_packed_pallas_sharded``) — bit-exact with the
+jnp path, tested in ``tests/test_gossip_sharded.py``.
 
 Works identically on a real TPU slice and on the virtual
 ``--xla_force_host_platform_device_count`` CPU mesh used by the tests and
@@ -106,11 +108,20 @@ class ShardedGossipSub:
         mesh: Optional[Mesh] = None,
         **gossip_kwargs,
     ):
-        if "use_pallas" in gossip_kwargs and gossip_kwargs["use_pallas"]:
-            raise ValueError("pallas path does not shard; use_pallas must be False")
-        gossip_kwargs["use_pallas"] = False
-        self.model = GossipSub(n_peers=n_peers, **gossip_kwargs)
+        # use_pallas=True routes the eager round through the shard_map-
+        # wrapped fused kernel (propagate_packed_pallas_sharded): the fresh
+        # table all-gathers over ICI and each device runs the kernel on its
+        # peer block — the 100k-peer sharded sim gets the fast kernel
+        # instead of being forced onto the jnp path (r4 verdict item 4).
+        # Default stays False (the GSPMD-partitioned jnp path).
+        use_pallas = bool(gossip_kwargs.pop("use_pallas", False))
         self.mesh = mesh if mesh is not None else make_mesh(n_devices)
+        self.model = GossipSub(
+            n_peers=n_peers,
+            use_pallas=use_pallas,
+            pallas_shard_mesh=self.mesh if use_pallas else None,
+            **gossip_kwargs,
+        )
         self.n_devices = self.mesh.shape[PEER_AXIS]
         if n_peers % self.n_devices != 0:
             raise ValueError(

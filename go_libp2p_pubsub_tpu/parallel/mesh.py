@@ -48,13 +48,13 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = PEER_AXIS) -> Mesh:
     return Mesh(np.array(devs[:n_devices]), (axis,))
 
 
-def peer_dim_spec(x: Any, axis: str = PEER_AXIS) -> P:
-    """PartitionSpec for one state leaf: shard dim 0 (the peer dim) when it
-    exists, replicate scalars."""
+def peer_dim_spec(x: Any, axis: str = PEER_AXIS, dim: int = 0) -> P:
+    """PartitionSpec for one state leaf: shard ``dim`` (the peer dim) when
+    it exists, replicate scalars."""
     ndim = getattr(x, "ndim", 0)
     if ndim == 0:
         return P()
-    return P(axis, *([None] * (ndim - 1)))
+    return P(*([None] * dim), axis, *([None] * (ndim - dim - 1)))
 
 
 def state_shardings(
@@ -62,6 +62,7 @@ def state_shardings(
     mesh: Mesh,
     axis: str = PEER_AXIS,
     replicated: frozenset = frozenset(),
+    peer_dim: Optional[dict] = None,
 ):
     """NamedSharding pytree matching ``state``: peer-dim arrays sharded,
     scalars replicated.  Peer-dim sizes must divide the mesh size.
@@ -80,31 +81,44 @@ def state_shardings(
     (e.g. msg_window == n_peers), in which case it is silently sharded —
     so classify every non-peer field explicitly rather than relying on the
     check to catch omissions.
+
+    ``peer_dim`` (NamedTuple states only) maps field names whose peer
+    dimension is NOT the leading one to its axis position — e.g. multitopic
+    state stacks per-topic leaves as [T, N, ...], so those fields pass
+    ``{name: 1}`` (``models.multitopic.MULTITOPIC_PEER_DIMS``).
     """
     n = mesh.shape[axis]
     repl = NamedSharding(mesh, P())
 
-    def one(x):
-        spec = peer_dim_spec(x, axis)
-        if getattr(x, "ndim", 0) >= 1 and x.shape[0] % n != 0:
+    def one(x, dim=0):
+        ndim = getattr(x, "ndim", 0)
+        if ndim >= 1 and ndim <= dim:
             raise ValueError(
-                f"peer dim {x.shape[0]} not divisible by mesh axis size {n}"
+                f"leaf of shape {x.shape} has no dim {dim} to shard"
+            )
+        spec = peer_dim_spec(x, axis, dim)
+        if ndim >= 1 and x.shape[dim] % n != 0:
+            raise ValueError(
+                f"peer dim {x.shape[dim]} not divisible by mesh axis size {n}"
             )
         return NamedSharding(mesh, spec)
 
     if hasattr(state, "_fields"):
-        unknown = replicated - set(state._fields)
+        peer_dim = peer_dim or {}
+        unknown = (replicated | set(peer_dim)) - set(state._fields)
         if unknown:
             raise ValueError(
-                f"replicated names not in {type(state).__name__}: "
+                f"classified names not in {type(state).__name__}: "
                 f"{sorted(unknown)}"
             )
         peer_dims = {
-            leaf.shape[0]
+            leaf.shape[peer_dim.get(name, 0)]
             for name in state._fields
             if name not in replicated
             for leaf in jax.tree.leaves(getattr(state, name))
-            if getattr(leaf, "ndim", 0) >= 1
+            # ndim > dim so a misclassified low-rank leaf reaches one()'s
+            # named ValueError instead of a bare IndexError here.
+            if getattr(leaf, "ndim", 0) > peer_dim.get(name, 0)
         }
         if len(peer_dims) > 1:
             raise ValueError(
@@ -115,14 +129,15 @@ def state_shardings(
             )
         return type(state)(**{
             name: jax.tree.map(
-                (lambda x: repl) if name in replicated else one,
+                (lambda x: repl) if name in replicated
+                else (lambda x, d=peer_dim.get(name, 0): one(x, d)),
                 getattr(state, name),
             )
             for name in state._fields
         })
-    if replicated:
+    if replicated or peer_dim:
         raise ValueError(
-            "replicated field names given but state is not a NamedTuple"
+            "field-name classifications given but state is not a NamedTuple"
         )
     return jax.tree.map(one, state)
 
@@ -132,9 +147,12 @@ def shard_state(
     mesh: Mesh,
     axis: str = PEER_AXIS,
     replicated: frozenset = frozenset(),
+    peer_dim: Optional[dict] = None,
 ):
     """Place a host/single-device state onto the mesh, peer-dim sharded."""
-    return jax.device_put(state, state_shardings(state, mesh, axis, replicated))
+    return jax.device_put(
+        state, state_shardings(state, mesh, axis, replicated, peer_dim)
+    )
 
 
 def sharded_fn(
@@ -143,6 +161,7 @@ def sharded_fn(
     example_state: Any,
     axis: str = PEER_AXIS,
     replicated: frozenset = frozenset(),
+    peer_dim: Optional[dict] = None,
     **jit_kw,
 ):
     """jit ``fn(state) -> state`` with peer-sharded in/out shardings pinned.
@@ -151,5 +170,5 @@ def sharded_fn(
     mesh, inserting ICI collectives where peers on different shards exchange
     messages — the array analog of cross-host streams riding the network.
     """
-    sh = state_shardings(example_state, mesh, axis, replicated)
+    sh = state_shardings(example_state, mesh, axis, replicated, peer_dim)
     return jax.jit(fn, in_shardings=(sh,), out_shardings=sh, **jit_kw)

@@ -72,12 +72,58 @@ def test_indivisible_peer_count_rejected():
         ShardedGossipSub(n_peers=250, n_devices=N_DEV, n_slots=16, conn_degree=8)
 
 
-def test_pallas_flag_rejected():
-    with pytest.raises(ValueError, match="pallas"):
-        ShardedGossipSub(
-            n_peers=256, n_devices=N_DEV, n_slots=16, conn_degree=8,
-            use_pallas=True,
-        )
+def test_sharded_pallas_kernel_matches_jnp():
+    """The shard_map-wrapped Pallas kernel (all-gathered fresh table, local
+    row blocks) must be bit-exact with the unsharded jnp reference on the
+    same inputs (r4 verdict item 4)."""
+    from go_libp2p_pubsub_tpu.models.gossipsub import build_topology
+    from go_libp2p_pubsub_tpu.ops import bitpack, gossip_packed
+    from go_libp2p_pubsub_tpu.ops.pallas_gossip import (
+        propagate_packed_pallas_sharded,
+    )
+    from go_libp2p_pubsub_tpu.parallel.mesh import make_mesh
+
+    n, k, m = 256, 16, 64
+    rng = np.random.default_rng(5)
+    nbrs, rev, valid, _ = build_topology(rng, n, k, 8)
+    mesh = valid & (rng.random((n, k)) < 0.6)
+    j = np.clip(nbrs, 0, n - 1)
+    mesh = mesh & mesh[j, np.clip(rev, 0, k - 1)]
+    alive = rng.random(n) < 0.9
+    have = rng.random((n, m)) < 0.2
+    fresh = have & (rng.random((n, m)) < 0.5)
+    msg_valid = rng.random(m) < 0.8
+    edge_live = valid & alive[np.clip(nbrs, 0, n - 1)]
+    args = (
+        jnp.asarray(mesh), jnp.asarray(nbrs, jnp.int32),
+        jnp.asarray(edge_live), jnp.asarray(alive),
+        bitpack.pack(jnp.asarray(have)), bitpack.pack(jnp.asarray(fresh)),
+        bitpack.pack(jnp.asarray(msg_valid)),
+    )
+    ref = gossip_packed.propagate_packed(*args)
+    out = propagate_packed_pallas_sharded(
+        make_mesh(N_DEV), *args, interpret=True
+    )
+    for la, lb in zip(ref, out):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_sharded_pallas_model_matches_jnp_model():
+    """ShardedGossipSub(use_pallas=True) — the shard_map kernel path — must
+    be leaf-for-leaf bit-identical with the default jnp sharded runner over
+    a full event sequence (publish, kill, rollout)."""
+    kw = dict(n_peers=256, n_devices=N_DEV, n_slots=16, conn_degree=8,
+              msg_window=32)
+    sj = ShardedGossipSub(**kw)
+    sp = ShardedGossipSub(use_pallas=True, **kw)
+    sa, sb = sj.init(seed=9), sp.init(seed=9)
+    sa = sj.publish(sa, jnp.int32(1), jnp.int32(2), jnp.asarray(True))
+    sb = sp.publish(sb, jnp.int32(1), jnp.int32(2), jnp.asarray(True))
+    kill = jnp.zeros((256,), bool).at[40:60].set(True)
+    sa, sb = sj.kill_peers(sa, kill), sp.kill_peers(sb, kill)
+    sa, sb = sj.run(sa, 10), sp.run(sb, 10)
+    for la, lb in zip(jax.tree.leaves(sa), jax.tree.leaves(sb)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
 
 
 def test_msg_window_equal_to_peer_count_not_missharded():
@@ -116,3 +162,31 @@ def test_unclassified_state_field_rejected():
             mod.gossip_state_shardings(st, sg.mesh, 16)
     finally:
         mod._PEER_DIM_FIELDS = orig
+
+
+def test_sharded_multitopic_matches_unsharded_bitwise():
+    """Multitopic sharding (topic-stacked leaves sharded on their PEER dim,
+    axis 1) must not change the computation: leaf-for-leaf bit-equality
+    with the unsharded run after identical events (r4 verdict item 7)."""
+    from go_libp2p_pubsub_tpu.models.multitopic import (
+        MultiTopicGossipSub, multitopic_state_shardings,
+    )
+    from go_libp2p_pubsub_tpu.parallel.mesh import make_mesh
+
+    mt = MultiTopicGossipSub(
+        n_topics=2, n_peers=128, n_slots=8, conn_degree=4, msg_window=32
+    )
+    sa = mt.init(seed=3)
+    sb = jax.device_put(
+        sa, multitopic_state_shardings(sa, make_mesh(N_DEV), mt.n)
+    )
+    args = (jnp.asarray(1), jnp.asarray(5), jnp.asarray(7), jnp.asarray(True))
+    sa, sb = mt.publish(sa, *args), mt.publish(sb, *args)
+    kill = jnp.zeros((128,), bool).at[30:40].set(True)
+    sa, sb = mt.kill_peers(sa, kill), mt.kill_peers(sb, kill)
+    sa, sb = mt.run(sa, 12), mt.run(sb, 12)
+    # The sharded run really is peer-sharded on dim 1 for stacked leaves.
+    assert sb.have_w.sharding.spec[1] == PEER_AXIS
+    assert sb.nbrs.sharding.spec[0] == PEER_AXIS
+    for la, lb in zip(jax.tree.leaves(sa), jax.tree.leaves(sb)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
