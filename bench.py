@@ -4,22 +4,34 @@ Headline (config e): validated msgs/sec + p50 propagation latency on a
 100k-peer GossipSub mesh simulation.  The validation loop is CLOSED: the
 message window is 128 REAL ed25519-signed envelopes (native C++ signer), a
 few deliberately forged; the per-message verdicts that gate relay inside the
-sim come from the JAX device kernel verifying those signatures — not a preset
-mask — and the forged ones are asserted undelivered.  The device verify time
-is charged against the headline throughput.
+sim come from verifying those signatures — not a preset mask — and the
+forged ones are asserted undelivered.  The headline charges the verification
+at the BEST backend (threaded C++ native) at production batch size: the
+window rides inside an 8192-signature batch and is charged its measured
+share of that batch's wall time.  The TPU device kernel verifies the same
+window as a cross-check and is reported separately with a batch-scaling
+curve (``ed25519_device_scaling``).
 
 Also measured and emitted as extra fields on the same JSON line:
 
+- ``phase_breakdown_ms``: where a rollout round's time goes — propagate vs
+  heartbeat, and inside the heartbeat scores / mesh / PX / IHAVE+IWANT /
+  fanout (the ``tools/profile_rollout.py`` machinery, recorded per round);
+- ``init_s`` / ``compile_s``: startup budgets (state init, rollout compile);
 - config (c): standalone batched ed25519 verify throughput, native C++
   (threaded) and TPU device kernel backends;
 - config (a): the in-process broadcast harness — a 10-peer dissemination
-  tree (the ``pubsub_test.go`` shape) driven by the lockstep engine,
-  deliveries/sec;
-- config (d): peer-score refresh + mesh maintenance (the full heartbeat)
-  step time at 100k peers.
+  tree (the ``pubsub_test.go`` shape) driven by the lockstep engine;
+- config (d): peer-score refresh + mesh maintenance heartbeat step time.
 
 Prints exactly one JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
+
+**Flake resilience** (r4 verdict item 1): the measurement runs in a CHILD
+process; the orchestrator parent falls back to a reduced-scale CPU run when
+the child dies or hangs for ANY reason — including a TPU backend that
+probes healthy and then dies at first real dispatch (the r4 failure) — and
+ALWAYS prints the JSON line, naming the backend that produced it.
 
 Baseline: the reference publishes no numbers (BASELINE.md); the driver's
 north-star target is 1M validated msgs/sec on a v5e-8 (BASELINE.json), so
@@ -34,24 +46,139 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-from go_libp2p_pubsub_tpu.models.gossipsub import GossipSub
-
-N_PEERS = 100_000
-N_SLOTS = 32
-DEGREE = 16
-N_MSGS = 128
-N_FORGED = 4  # deliberately invalid envelopes in the window
-ROLLOUT_STEPS = 24  # p50 converges in ~5 rounds; 24 covers p100 + heartbeats
 BASELINE_MSGS_PER_SEC = 1_000_000.0
-DEVICE_PAD = 512  # one compiled batch shape for the device ed25519 kernel
+N_MSGS = 128
+N_FORGED = 4           # deliberately invalid envelopes in the window
+ROLLOUT_STEPS = 24     # p50 converges in ~5 rounds; 24 covers p100 + heartbeats
+NATIVE_BATCH = 8192    # production verify batch the window is folded into
+
+# Child scale knobs (env-selected by the orchestrator).
+TPU_SCALE = dict(n_peers=100_000, n_slots=32, degree=16,
+                 device_curve=(512, 2048, 8192, 32768), reps=8)
+CPU_SCALE = dict(n_peers=16_384, n_slots=32, degree=16,
+                 device_curve=(512, 2048), reps=2)
+
+PROBE_TIMEOUT_S = 180.0
+# The r3 TPU run took ~4.5 min, and the r5 child adds the device-kernel
+# scaling curve (4 compiled batch shapes) and the phase-breakdown compiles,
+# so the budget is ~3x r3.  A mid-run backend death normally crashes rc:1
+# within seconds (r4) and a post-JSON teardown hang is salvaged from the
+# timeout's captured stdout, so the full timeout is only ever spent on a
+# genuine mid-measurement hang.
+TPU_RUN_TIMEOUT_S = 1500.0
+CPU_RUN_TIMEOUT_S = 1200.0  # measured ~11 min on the 1-CPU box
 
 
 def log(msg: str) -> None:
-    print(msg, file=sys.stderr)
+    print(msg, file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# orchestrator: run the child, fall back, ALWAYS print one JSON line
+# ---------------------------------------------------------------------------
+
+
+def probe_backend(timeout_s: float = PROBE_TIMEOUT_S) -> bool:
+    """True iff the default backend initializes AND is an accelerator (a
+    CPU-only box must go straight to the CPU-scale fallback, not burn the
+    full-scale attempt's timeout), probed in a subprocess.  A dead TPU
+    tunnel hangs backend init in-process for tens of minutes with no way to
+    cancel it; the subprocess bounds the probe.  The probe passing does NOT
+    guarantee the run survives (the r4 tunnel died at first dispatch AFTER
+    a clean probe) — the child timeout + rc check below are the real guard;
+    this probe just fails fast when the tunnel is already down."""
+    try:
+        r = subprocess.run(
+            [
+                sys.executable, "-c",
+                "import jax, sys; "
+                "sys.exit(0 if jax.devices()[0].platform != 'cpu' else 1)",
+            ],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def _parse_json_line(out: str):
+    """Last stdout line that parses as a JSON object, or None."""
+    for line in reversed(out.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                return None
+    return None
+
+
+def run_child(env_extra: dict, timeout_s: float):
+    """Run ``bench.py --child`` in a subprocess; returns (parsed JSON dict
+    or None, tail of output for diagnostics).  stderr passes through live."""
+    env = dict(os.environ, **env_extra)
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            timeout=timeout_s,
+            stdout=subprocess.PIPE,
+            stderr=None,  # child progress logs stream through
+            env=env,
+        )
+    except subprocess.TimeoutExpired as e:
+        # A child that finished measuring and then hung in backend teardown
+        # (the dead-tunnel hang class) has already printed its JSON line —
+        # salvage it rather than discarding a full-scale result.
+        out = (e.stdout or b"").decode(errors="replace")
+        parsed = _parse_json_line(out)
+        if parsed is not None:
+            return parsed, out[-500:]
+        return None, f"child timed out after {timeout_s:.0f}s; stdout: {out[-500:]}"
+    out = r.stdout.decode(errors="replace")
+    parsed = _parse_json_line(out)
+    if parsed is not None:
+        return parsed, out[-500:]
+    return None, f"child rc={r.returncode}; stdout tail: {out[-500:]}"
+
+
+def orchestrate() -> None:
+    attempts = []
+    if probe_backend():
+        log("orchestrator: TPU probe ok; running full-scale child")
+        parsed, tail = run_child({"BENCH_MODE": "tpu"}, TPU_RUN_TIMEOUT_S)
+        if parsed is not None:
+            print(json.dumps(parsed))
+            return
+        attempts.append(f"tpu attempt failed: {tail}")
+        log(f"orchestrator: TPU child failed ({tail[:200]}); falling back to CPU")
+    else:
+        attempts.append("tpu probe failed (backend init hang/crash)")
+        log("orchestrator: TPU probe failed; falling back to CPU")
+
+    parsed, tail = run_child(
+        {"BENCH_MODE": "cpu", "JAX_PLATFORMS": "cpu"}, CPU_RUN_TIMEOUT_S
+    )
+    if parsed is not None:
+        print(json.dumps(parsed))
+        return
+    attempts.append(f"cpu attempt failed: {tail}")
+
+    # Both attempts dead: still print the JSON line (rc 0) so the round has
+    # a record instead of a crash.
+    print(json.dumps({
+        "metric": "gossipsub_100k_validated_msgs_per_sec",
+        "value": 0.0,
+        "unit": "msgs/sec",
+        "vs_baseline": 0.0,
+        "backend": "unavailable",
+        "error": " | ".join(a[:400] for a in attempts),
+    }))
+
+
+# ---------------------------------------------------------------------------
+# child: the actual measurements
+# ---------------------------------------------------------------------------
 
 
 def make_signed_window(rng):
@@ -62,9 +189,7 @@ def make_signed_window(rng):
 
     seeds = [rng.bytes(32) for _ in range(N_MSGS)]
     payloads = [rng.bytes(64) for _ in range(N_MSGS)]
-    msgs = [
-        signing_bytes("bench", i, p) for i, p in enumerate(payloads)
-    ]
+    msgs = [signing_bytes("bench", i, p) for i, p in enumerate(payloads)]
     pks = native.public_key_batch(seeds)
     sigs = native.sign_batch(seeds, msgs)
     forged_idx = set(rng.choice(N_MSGS, size=N_FORGED, replace=False).tolist())
@@ -77,65 +202,75 @@ def make_signed_window(rng):
     return envs, forged_idx
 
 
-def device_verify_window(envs):
-    """Verify the window's signatures on the TPU device kernel; returns
-    (verdicts bool[N_MSGS], seconds, sigs_per_sec_at_DEVICE_PAD)."""
+def native_verify_window(envs, rng):
+    """Best-backend (threaded C++) verify of the window at production batch
+    size: the window's envelopes ride inside a NATIVE_BATCH-signature batch
+    of genuine filler, and the headline is charged the window's share of the
+    batch's wall time.  Returns (window verdicts bool[N_MSGS],
+    charged_seconds, batch_sigs_per_sec)."""
+    import numpy as np
+
+    from go_libp2p_pubsub_tpu.crypto import native
+    from go_libp2p_pubsub_tpu.crypto.pipeline import signing_bytes
+
+    n_fill = NATIVE_BATCH - N_MSGS
+    fill_seeds = [rng.bytes(32) for _ in range(n_fill)]
+    fill_msgs = [rng.bytes(64) for _ in range(n_fill)]
+    fill_pks = native.public_key_batch(fill_seeds)
+    fill_sigs = native.sign_batch(fill_seeds, fill_msgs)
+
+    pks = [e.pubkey for e in envs] + list(fill_pks)
+    msgs = [signing_bytes(e.topic, e.seqno, e.payload) for e in envs] + fill_msgs
+    sigs = [e.signature for e in envs] + list(fill_sigs)
+
+    native.verify_batch(pks[:64], msgs[:64], sigs[:64])  # warm threads/lib
+    t0 = time.perf_counter()
+    ok = np.asarray(native.verify_batch(pks, msgs, sigs))
+    dt = time.perf_counter() - t0
+    assert bool(ok[N_MSGS:].all()), "native verify rejected genuine filler"
+    charged = dt * (N_MSGS / NATIVE_BATCH)
+    return ok[:N_MSGS], charged, NATIVE_BATCH / dt
+
+
+def device_verify_window(envs, pad_to):
+    """Verify the window's signatures on the TPU device kernel at batch
+    ``pad_to``; returns (verdicts bool[N_MSGS], measured_s, sigs/s)."""
     from go_libp2p_pubsub_tpu.crypto.pipeline import signing_bytes
     from go_libp2p_pubsub_tpu.ops import ed25519 as dev
 
     pks = [e.pubkey for e in envs]
     msgs = [signing_bytes(e.topic, e.seqno, e.payload) for e in envs]
     sigs = [e.signature for e in envs]
-    # Warm/compile at the padded shape, then measure.
-    dev.verify_batch(pks, msgs, sigs, pad_to=DEVICE_PAD)
+    dev.verify_batch(pks, msgs, sigs, pad_to=pad_to)  # compile at this shape
     t0 = time.perf_counter()
-    verdicts = dev.verify_batch(pks, msgs, sigs, pad_to=DEVICE_PAD)
+    verdicts = dev.verify_batch(pks, msgs, sigs, pad_to=pad_to)
     dt = time.perf_counter() - t0
-    # The kernel performs DEVICE_PAD curve verifications (padding included),
-    # so DEVICE_PAD/dt is the kernel's throughput AT THAT BATCH SIZE — the
-    # emitted field name carries the batch so it can't be read as the
-    # (smaller) real-window rate.
-    return verdicts, dt, DEVICE_PAD / dt
-
-
-def bench_native_ed25519(rng, n=8192):
-    """Config (c), native backend: threaded C++ batch verify, sigs/sec."""
-    from go_libp2p_pubsub_tpu.crypto import native
-
-    seeds = [rng.bytes(32) for _ in range(n)]
-    msgs = [rng.bytes(64) for _ in range(n)]
-    pks = native.public_key_batch(seeds)
-    sigs = native.sign_batch(seeds, msgs)
-    native.verify_batch(pks[:64], msgs[:64], sigs[:64])  # warm threads/lib
-    t0 = time.perf_counter()
-    ok = native.verify_batch(pks, msgs, sigs)
-    dt = time.perf_counter() - t0
-    assert bool(np.all(ok)), "native verify rejected a genuine signature"
-    return n / dt
+    # The kernel performs pad_to curve verifications (padding included), so
+    # pad_to/dt is the kernel's throughput AT THAT BATCH SIZE.
+    return verdicts, dt, pad_to / dt
 
 
 def bench_treecast(n_msgs=64, n_peers=10):
     """Config (a): the reference's in-process broadcast harness shape —
     one root + 9 subscribers, width-2 tree — driven by the lockstep engine.
     Returns (deliveries/sec, steps/sec)."""
+    import jax
+    import jax.numpy as jnp
+
     from go_libp2p_pubsub_tpu.config import SimParams, TreeOpts
     from go_libp2p_pubsub_tpu.ops import tree as tree_ops
 
     params = SimParams(max_peers=16, max_width=8, queue_cap=128, out_cap=128)
     st = tree_ops.init_state(params, TreeOpts(), root=0)
-    st = tree_ops.begin_subscribe_many(
-        st, jnp.arange(16) % 16 < n_peers
-    )
+    st = tree_ops.begin_subscribe_many(st, jnp.arange(16) % 16 < n_peers)
     for _ in range(32):  # converge joins
         st = tree_ops.step(st)
     st = jax.block_until_ready(st)
     assert int(st.joined.sum()) == n_peers
 
     st = tree_ops.publish_many(st, jnp.arange(n_msgs, dtype=jnp.int32))
-    # Each step pops at most one queued message per peer, so n_msgs + depth
-    # steps drain the whole window.
     steps = n_msgs + 8
-    warm = jax.block_until_ready(tree_ops.run_steps(st, steps))
+    jax.block_until_ready(tree_ops.run_steps(st, steps))  # compile
     t0 = time.perf_counter()
     out = jax.block_until_ready(tree_ops.run_steps(st, steps))
     dt = time.perf_counter() - t0
@@ -146,89 +281,181 @@ def bench_treecast(n_msgs=64, n_peers=10):
     return delivered / dt, steps / dt
 
 
-def bench_scoring_heartbeat(gs, st):
-    """Config (d): the full score refresh + mesh maintenance heartbeat
-    (decay, P1-P7 re-score, prune/graft, gossip emission) at 100k peers.
-    Returns milliseconds per heartbeat."""
-    hb = jax.jit(gs._heartbeat)
-    jax.block_until_ready(hb(st))  # compile
+def phase_breakdown(gs, st, reps):
+    """Per-phase times (ms) of one rollout round at the bench scale: the
+    ``tools/profile_rollout.py`` machinery recorded into the bench JSON (r4
+    verdict item 1).  Sub-phases re-run the heartbeat's own kernels on the
+    same state the heartbeat sees."""
+    import jax
+
+    from go_libp2p_pubsub_tpu.ops import gossip_packed as gossip_ops
+    from go_libp2p_pubsub_tpu.ops import scoring as scoring_ops
+    from go_libp2p_pubsub_tpu.ops.gossip import heartbeat_mesh
+    from go_libp2p_pubsub_tpu.ops.graphs import safe_gather
+    from go_libp2p_pubsub_tpu.ops.px import px_rewire
+
+    p, sp = gs.params, gs.score_params
+    out = {}
+
+    def timeit(name, fn, *args):
+        # Arrays MUST ride as jit ARGUMENTS: a closure over device arrays
+        # turns them into compile-time constants and XLA constant-folds the
+        # whole phase away (measuring a cached literal, not the kernel).
+        f = jax.jit(fn)
+        o = jax.block_until_ready(f(*args))  # compile
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            o = f(*args)
+        jax.block_until_ready(o)
+        out[name] = round((time.perf_counter() - t0) / reps * 1e3, 2)
+
+    # gs.step's heartbeat rides a lax.cond keyed on st.step % heartbeat_steps,
+    # so timing step() at one fixed st measures ONE branch; the honest
+    # per-round figure times a full heartbeat cycle and divides.
+    hb_steps = gs.heartbeat_steps
+
+    def full_cycle(s):
+        return gs.run(s, hb_steps)
+
+    f = jax.jit(full_cycle)
+    jax.block_until_ready(f(st))
     t0 = time.perf_counter()
-    for _ in range(4):
-        st = hb(st)
-    jax.block_until_ready(st)
-    return (time.perf_counter() - t0) / 4 * 1e3
+    for _ in range(max(1, reps // 2)):
+        o = f(st)
+    jax.block_until_ready(o)
+    out["round_amortized"] = round(
+        (time.perf_counter() - t0) / max(1, reps // 2) / hb_steps * 1e3, 2
+    )
+    timeit("propagate", gs._propagate, st)
+    timeit("heartbeat", gs._heartbeat, st)
+
+    def scores_fn(counters, gcounters, mesh, nbrs, nbr_valid):
+        c = scoring_ops.tick_mesh_clocks(counters, mesh, p.heartbeat_interval_s)
+        c = scoring_ops.decay_topic_counters(c, sp)
+        g = scoring_ops.decay_global_counters(gcounters, sp)
+        return scoring_ops.neighbor_scores(c, g, nbrs, nbr_valid, sp)
+
+    timeit("hb_scores", scores_fn,
+           st.counters, st.gcounters, st.mesh, st.nbrs, st.nbr_valid)
+    scores = jax.jit(scores_fn)(
+        st.counters, st.gcounters, st.mesh, st.nbrs, st.nbr_valid
+    )
+    part = st.alive & st.subscribed
+    edge_ok = st.edge_live & st.nbr_sub
+    key = jax.random.PRNGKey(1)
+
+    def mesh_fn(k_, mesh, sc, nbrs, rev, eo, al, bo, ob):
+        return heartbeat_mesh(
+            k_, mesh, sc, nbrs, rev, eo, al, p, bo, ob, False,
+            og_threshold=sp.opportunistic_graft_threshold)
+
+    timeit("hb_mesh", mesh_fn, key, st.mesh, scores, st.nbrs, st.rev,
+           edge_ok, part, st.backoff, st.outbound)
+    nm, gr, pr, bo, bv = jax.jit(mesh_fn)(
+        key, st.mesh, scores, st.nbrs, st.rev, edge_ok, part,
+        st.backoff, st.outbound)
+
+    def px_fn(k_, nbrs, rev, nv, ob, bo_, nm_, pr_, sc, al):
+        return px_rewire(k_, nbrs, rev, nv, ob, bo_, nm_, pr_, sc, al,
+                         sp.accept_px_threshold)
+
+    timeit("hb_px", px_fn, key, st.nbrs, st.rev, st.nbr_valid, st.outbound,
+           bo, nm, pr, scores, st.alive)
+
+    # Masks and fanout logic come from the model's own shared helpers
+    # (gossip_window_masks / fanout_maintenance), so the profiled kernels
+    # cannot drift from the shipped heartbeat.
+    have_scrubbed, gossip_w = jax.jit(gs.gossip_window_masks)(st)
+
+    def ihave_iwant(k_, have_adv, have_dedup, nm_, nbrs, rev, eo, al, sc,
+                    gw, mute):
+        serve_ok = ~safe_gather(mute, nbrs, True)
+        return gossip_ops.gossip_exchange_packed(
+            k_, k_, have_adv, have_dedup, nm_, nbrs, rev, eo, al, sc, gw,
+            p, sp.gossip_threshold, serve_ok, p.max_iwant_length)
+
+    timeit("hb_gossip", ihave_iwant, key, st.have_w, have_scrubbed, nm,
+           st.nbrs, st.rev, edge_ok, part, scores, gossip_w, st.gossip_mute)
+
+    timeit("hb_fanout", gs.fanout_maintenance, key, st.fanout,
+           st.fanout_age, st.subscribed, st.alive, edge_ok, scores)
+    return out
 
 
-def probe_backend(timeout_s: float = 180.0) -> bool:
-    """True iff the default (TPU) backend initializes, probed in a SUBPROCESS.
+def child_main() -> None:
+    mode = os.environ.get("BENCH_MODE", "tpu")
+    scale = TPU_SCALE if mode == "tpu" else CPU_SCALE
 
-    A dead TPU tunnel hangs backend init in-process for tens of minutes with
-    no way to cancel it (this is exactly how the round-2 bench run died with
-    rc:1 and no number).  The subprocess bounds the probe; on failure the
-    bench falls back to CPU at reduced scale and says so in the JSON.
-    """
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s,
-            capture_output=True,
-        )
-        return r.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
 
-
-def main():
-    global N_PEERS
-    backend_note = "default"
-    if not probe_backend():
-        log("TPU backend unavailable; falling back to CPU at reduced scale")
+    if mode == "cpu":
+        # Env alone loses to the container's axon sitecustomize config pin.
         jax.config.update("jax_platforms", "cpu")
-        N_PEERS = 16_384  # CPU fallback: keep the rollout under a few minutes
-        backend_note = "cpu-fallback (TPU tunnel unavailable)"
+
+    from go_libp2p_pubsub_tpu.models.gossipsub import GossipSub
+
+    n_peers = scale["n_peers"]
     dev = jax.devices()[0]
-    log(f"bench device: {dev.device_kind}")
+    backend_note = "default" if mode == "tpu" else "cpu-fallback (TPU unavailable)"
+    log(f"bench device: {dev.device_kind}  mode={mode}  n_peers={n_peers}")
     rng = np.random.default_rng(1)
 
-    # -- signed message window + device-kernel verdicts (closes the loop) ---
+    # -- signed message window, verified on BOTH backends -------------------
     t0 = time.perf_counter()
     envs, forged_idx = make_signed_window(rng)
     log(f"signed window ({N_MSGS} envelopes, {N_FORGED} forged): "
         f"{time.perf_counter()-t0:.1f}s")
-    t0 = time.perf_counter()
-    verdicts, verify_dt, device_sigs_per_sec = device_verify_window(envs)
-    log(f"device ed25519 verdicts: {verify_dt*1e3:.0f} ms measured "
-        f"(+{time.perf_counter()-t0-verify_dt:.1f}s compile); "
-        f"{device_sigs_per_sec:.0f} sigs/sec at batch {DEVICE_PAD}")
     expected = np.array([i not in forged_idx for i in range(N_MSGS)])
-    assert bool(np.all(verdicts == expected)), "device verdicts wrong"
 
-    native_sigs_per_sec = bench_native_ed25519(rng)
-    log(f"native ed25519: {native_sigs_per_sec:.0f} sigs/sec")
+    # Headline charge: best backend (threaded C++), production batch.
+    verdicts, verify_dt, native_batch_rate = native_verify_window(envs, rng)
+    assert bool(np.all(verdicts == expected)), "native verdicts wrong"
+    log(f"native verify: window charged {verify_dt*1e3:.2f} ms "
+        f"(128/{NATIVE_BATCH} share of a {native_batch_rate:.0f} sigs/s batch)")
+
+    # Device kernel cross-check + batch-scaling curve (reported, not charged).
+    device_curve = {}
+    for pad in scale["device_curve"]:
+        t0 = time.perf_counter()
+        dv, dt, rate = device_verify_window(envs, pad)
+        device_curve[str(pad)] = round(rate, 1)
+        log(f"device ed25519 @ batch {pad}: {dt*1e3:.0f} ms, "
+            f"{rate:.0f} sigs/s (+{time.perf_counter()-t0-dt:.1f}s compile)")
+        assert bool(np.all(np.asarray(dv) == expected)), (
+            f"device verdicts disagree with native at batch {pad}"
+        )
+
+    # Config (c) native rate: the batch native_verify_window already timed
+    # (a second full sign+verify of 8192 would measure the same thing twice).
+    native_sigs_per_sec = native_batch_rate
+    log(f"native ed25519: {native_sigs_per_sec:.0f} sigs/sec (8192 batch)")
 
     # -- config (a): tree broadcast harness ---------------------------------
     tree_msgs_per_sec, tree_steps_per_sec = bench_treecast()
     log(f"treecast 10-peer: {tree_msgs_per_sec:.0f} deliveries/sec "
         f"({tree_steps_per_sec:.0f} steps/sec)")
 
-    # -- headline: 100k-peer gossipsub with kernel-verified window ----------
+    # -- headline: N-peer gossipsub with kernel-verified window -------------
     gs = GossipSub(
-        n_peers=N_PEERS,
-        n_slots=N_SLOTS,
-        conn_degree=DEGREE,
+        n_peers=n_peers,
+        n_slots=scale["n_slots"],
+        conn_degree=scale["degree"],
         msg_window=N_MSGS,
     )
     t0 = time.perf_counter()
     st = gs.init(seed=0)
     jax.block_until_ready(st.mesh)
-    log(f"init ({N_PEERS} peers): {time.perf_counter()-t0:.1f}s")
+    init_s = time.perf_counter() - t0
+    log(f"init ({n_peers} peers): {init_s:.1f}s")
 
     for slot in range(N_MSGS):
         st = gs.publish(
             st,
-            jnp.int32(int(rng.integers(N_PEERS))),
+            jnp.int32(int(rng.integers(n_peers))),
             jnp.int32(slot),
-            jnp.asarray(bool(verdicts[slot])),  # REAL kernel verdict
+            jnp.asarray(bool(verdicts[slot])),  # REAL backend verdict
         )
     jax.block_until_ready(st.have_w)
 
@@ -236,15 +463,18 @@ def main():
     t0 = time.perf_counter()
     warm = rollout(st)  # compile
     jax.block_until_ready(warm.have_w)
-    log(f"compile+warm rollout: {time.perf_counter()-t0:.1f}s")
+    compile_s = time.perf_counter() - t0
+    log(f"compile+warm rollout: {compile_s:.1f}s")
 
     t0 = time.perf_counter()
     out = rollout(st)
     jax.block_until_ready(out.have_w)
     rollout_dt = time.perf_counter() - t0
 
-    scoring_ms = bench_scoring_heartbeat(gs, out)
-    log(f"scoring+mesh heartbeat at {N_PEERS} peers: {scoring_ms:.1f} ms")
+    # -- per-phase breakdown + standalone heartbeat -------------------------
+    phases = phase_breakdown(gs, out, scale["reps"])
+    scoring_ms = phases["heartbeat"]
+    log(f"phase breakdown (ms): {phases}")
 
     frac, p50, p99 = (np.asarray(x) for x in gs.delivery_stats(out))
     mean_frac = float(np.nanmean(frac))
@@ -254,15 +484,15 @@ def main():
     have = np.asarray(gs.have_bool(out))
     for i in forged_idx:
         assert int(have[:, i].sum()) <= 1, f"forged msg {i} propagated"
-    delivered = float(np.nansum(frac)) * N_PEERS
+    delivered = float(np.nansum(frac)) * n_peers
     # Charge the signature verification against the headline.
     total_dt = rollout_dt + verify_dt
     value = delivered / total_dt
 
     log(
         f"{delivered:.0f} validated deliveries in {total_dt*1e3:.0f} ms "
-        f"(rollout {rollout_dt*1e3:.0f} + verify {verify_dt*1e3:.0f}; "
-        f"{ROLLOUT_STEPS} rounds, {N_PEERS} peers, {N_MSGS} msgs, "
+        f"(rollout {rollout_dt*1e3:.0f} + verify {verify_dt*1e3:.1f}; "
+        f"{ROLLOUT_STEPS} rounds, {n_peers} peers, {N_MSGS} msgs, "
         f"p50 {float(p50):.0f} / p99 {float(p99):.0f} rounds)"
     )
     print(
@@ -274,19 +504,29 @@ def main():
                 "vs_baseline": round(value / BASELINE_MSGS_PER_SEC, 4),
                 "p50_latency_rounds": float(p50),
                 "delivery_frac": round(mean_frac, 6),
-                "n_peers": N_PEERS,
+                "n_peers": n_peers,
                 "backend": f"{dev.device_kind} ({backend_note})",
-                "window_verify": "ed25519 device kernel, 4 forged rejected",
-                f"ed25519_device_sigs_per_sec_at_batch_{DEVICE_PAD}": round(
-                    device_sigs_per_sec, 1
+                "propagate_kernel": "pallas" if gs.use_pallas else "jnp",
+                "window_verify": (
+                    f"ed25519 native C++ (threaded), {N_FORGED} forged "
+                    f"rejected; device kernel cross-checked"
                 ),
+                "window_verify_charged_ms": round(verify_dt * 1e3, 2),
+                "init_s": round(init_s, 1),
+                "compile_s": round(compile_s, 1),
+                "phase_breakdown_ms": phases,
+                "ed25519_device_scaling": device_curve,
                 "ed25519_native_sigs_per_sec": round(native_sigs_per_sec, 1),
                 "treecast_10peer_deliveries_per_sec": round(tree_msgs_per_sec, 1),
-                "scoring_heartbeat_100k_ms": round(scoring_ms, 2),
+                "scoring_heartbeat_ms": scoring_ms,
             }
-        )
+        ),
+        flush=True,
     )
 
 
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        child_main()
+    else:
+        orchestrate()

@@ -562,6 +562,64 @@ class GossipSub:
 
     # -- transition ---------------------------------------------------------
 
+    def seen_ttl_steps(self) -> int:
+        """Rounds after which a receipt falls out of the seen-cache dedup."""
+        p = self.params
+        return (
+            max(1, round(p.seen_ttl_s / p.heartbeat_interval_s))
+            * self.heartbeat_steps
+        )
+
+    def fanout_ttl_heartbeats(self) -> int:
+        """Heartbeats of publish silence after which fanout state ages out."""
+        p = self.params
+        return max(1, round(p.fanout_ttl_s / p.heartbeat_interval_s))
+
+    def gossip_window_masks(self, st: GossipState):
+        """(have_scrubbed u32[N, W], gossip_w u32[W]): the seen-TTL-scrubbed
+        possession view the IWANT dedups against, and the packed
+        advertisable window (valid & active & within history_gossip).
+        Shared by ``_heartbeat`` and the bench's phase profiler so the
+        profiled masks can never drift from the shipped ones."""
+        p = self.params
+        seen_expired = st.msg_used & (
+            st.step - st.msg_birth > self.seen_ttl_steps()
+        )
+        have_scrubbed = st.have_w & ~bitpack.pack(seen_expired)
+        gossip_age_ok = (
+            st.step - st.msg_birth
+            <= p.history_gossip * self.heartbeat_steps
+        )
+        gossip_w = bitpack.pack(st.msg_valid & st.msg_active & gossip_age_ok)
+        return have_scrubbed, gossip_w
+
+    def fanout_maintenance(
+        self, key, fanout, fanout_age, subscribed, alive, edge_eligible,
+        scores,
+    ):
+        """One heartbeat of fanout upkeep -> (fanout bool[N, K], age i32[N]):
+        age out after ``fanout_ttl_s`` of publish silence, drop
+        dead/below-threshold peers, top back up to D while active.  Shared
+        by ``_heartbeat`` and the bench's phase profiler."""
+        p, sp = self.params, self.score_params
+        age = jnp.minimum(fanout_age + 1, jnp.iinfo(jnp.int32).max // 2)
+        factive = (age <= self.fanout_ttl_heartbeats()) & ~subscribed & alive
+        feligible = edge_eligible & (scores >= sp.publish_threshold)
+        fkeep = fanout & feligible
+        fwant = jnp.where(
+            factive, jnp.clip(p.d - fkeep.sum(axis=1), 0, p.d), 0
+        ).astype(jnp.int32)
+        fadd = top_mask(
+            jnp.where(
+                feligible & ~fkeep,
+                jax.random.uniform(key, (self.n, self.k)),
+                -jnp.inf,
+            ),
+            fwant,
+            kmax=p.d,
+        )
+        return jnp.where(factive[:, None], fkeep | fadd, False), age
+
     def _heartbeat(self, st: GossipState) -> GossipState:
         p, sp = self.params, self.score_params
         khb, kgossip, kiwant, kfan, kpx, knext = jax.random.split(st.key, 6)
@@ -615,12 +673,7 @@ class GossipSub:
         # the grant matches what the next round would have computed):
         # receipts older than seen_ttl_s fall out of the dedup window
         # (first_step keeps the delivery record for metrics).
-        seen_ttl_steps = (
-            max(1, round(p.seen_ttl_s / p.heartbeat_interval_s))
-            * self.heartbeat_steps
-        )
-        seen_expired = st.msg_used & (st.step - st.msg_birth > seen_ttl_steps)
-        have_w = st.have_w & ~bitpack.pack(seen_expired)
+        have_w, gossip_w = self.gossip_window_masks(st)
 
         # Two-phase IHAVE/IWANT, collapsed at the heartbeat: advertisements
         # are computed per receiving slot, each receiver immediately selects
@@ -634,25 +687,20 @@ class GossipSub:
         # IWANT on the next round: offers folded between heartbeat and next
         # round (a publish racing the heartbeat) are not deduped against —
         # the same race an IWANT on the wire loses.
-        gossip_age_ok = (
-            st.step - st.msg_birth <= p.history_gossip * self.heartbeat_steps
-        )
-        gossip_w = bitpack.pack(st.msg_valid & st.msg_active & gossip_age_ok)
-        adv_w = gossip_ops.ihave_advertise_packed(
-            kgossip, st.have_w, new_mesh, px.nbrs, px.rev,
-            edge_live & nbr_sub, part, scores, gossip_w, p,
-            sp.gossip_threshold,
-        )
         # An advertiser serves unless it is a promise-breaker (gossip_mute)
         # — death is already excluded by edge_live in the selection.  The
         # receiver ignores IHAVEs from advertisers it scores below
         # gossip_threshold (go's handleIHave gate) and draws the ask target
         # in keyed random slot order, so a low-slot promise-breaker cannot
-        # permanently starve ids an honest advertiser also offers.
+        # permanently starve ids an honest advertiser also offers.  The
+        # fused kernel builds the advertisement cube directly in that
+        # priority order (one [N,K,W] gather; bit-exact with the unfused
+        # advertise+select pair, which stays as the tested reference).
         serve_ok = ~safe_gather(st.gossip_mute, px.nbrs, True)
-        iwant_pend_w, broken = gossip_ops.iwant_select_packed(
-            kiwant, adv_w, have_w, edge_live & nbr_sub, scores, serve_ok,
-            part, p.max_iwant_length, sp.gossip_threshold,
+        iwant_pend_w, broken = gossip_ops.gossip_exchange_packed(
+            kgossip, kiwant, st.have_w, have_w, new_mesh, px.nbrs, px.rev,
+            edge_live & nbr_sub, part, scores, gossip_w, p,
+            sp.gossip_threshold, serve_ok, p.max_iwant_length,
         )
         # P7: broken promises charge the ADVERTISER (indexed by remote id).
         promise_ids = jnp.where(
@@ -663,31 +711,11 @@ class GossipSub:
         )[: self.n]
         g = g._replace(behaviour_penalty=g.behaviour_penalty + promise_viol)
 
-        # Fanout maintenance for non-subscribed publishers: age out after
-        # fanout_ttl_s of publish silence; drop dead/below-threshold peers;
-        # top back up to D while active.
-        fanout_ttl_hb = max(
-            1, round(p.fanout_ttl_s / p.heartbeat_interval_s)
+        # Fanout maintenance for non-subscribed publishers.
+        fanout, age = self.fanout_maintenance(
+            kfan, st.fanout, st.fanout_age, st.subscribed, st.alive,
+            edge_live & nbr_sub, scores,
         )
-        age = jnp.minimum(
-            st.fanout_age + 1, jnp.iinfo(jnp.int32).max // 2
-        )
-        factive = (age <= fanout_ttl_hb) & ~st.subscribed & st.alive
-        feligible = edge_live & nbr_sub & (scores >= sp.publish_threshold)
-        fkeep = st.fanout & feligible
-        fwant = jnp.where(
-            factive, jnp.clip(p.d - fkeep.sum(axis=1), 0, p.d), 0
-        ).astype(jnp.int32)
-        fadd = top_mask(
-            jnp.where(
-                feligible & ~fkeep,
-                jax.random.uniform(kfan, (self.n, self.k)),
-                -jnp.inf,
-            ),
-            fwant,
-            kmax=p.d,
-        )
-        fanout = jnp.where(factive[:, None], fkeep | fadd, False)
 
         # Expire messages out of the mcache history window.  (iwant_pend_w
         # needs no strike: the grant was gated by gossip_age_ok, which is

@@ -444,16 +444,13 @@ class MultiTopicGossipSub:
             gossip_age_ok = (
                 st.step - mbirth <= p.history_gossip * self.heartbeat_steps
             )
-            adv = gossip_ops.ihave_advertise_packed(
-                kgossip, have_t, new_mesh, st.nbrs, st.rev, el, al, scores,
-                bitpack.pack(mv & ma & gossip_age_ok), p, sp.gossip_threshold,
-            )
-            # IWANT grant + promise accounting (see the single-topic
-            # heartbeat): transfers land two rounds out via iwant_pend_w,
-            # score-gated and randomly prioritized like the single-topic path.
-            iwant_t, broken_t = gossip_ops.iwant_select_packed(
-                kiwant, adv, have2, el, scores, serve_ok, al,
-                p.max_iwant_length, sp.gossip_threshold,
+            # Fused IHAVE/IWANT with promise accounting (see the
+            # single-topic heartbeat): transfers land two rounds out via
+            # iwant_pend_w, score-gated and randomly prioritized.
+            iwant_t, broken_t = gossip_ops.gossip_exchange_packed(
+                kgossip, kiwant, have_t, have2, new_mesh, st.nbrs, st.rev,
+                el, al, scores, bitpack.pack(mv & ma & gossip_age_ok), p,
+                sp.gossip_threshold, serve_ok, p.max_iwant_length,
             )
             # Fanout upkeep for this topic's non-subscribed publishers.
             fage2 = jnp.minimum(fage_t + 1, jnp.iinfo(jnp.int32).max // 2)

@@ -190,18 +190,95 @@ def iwant_select_packed(
     accept = edge_live & (scores >= gossip_threshold)
     want = adv_w & ~have_w[:, None, :] & _as_mask(accept)[:, :, None]
     perm, inv = iwant_priority(key, n, k)
+    # ONE [N,K,W] cube gather into priority order; everything downstream
+    # stays permuted.  The ask cap is per-slot (order-independent), ``pend``
+    # is an OR over slots (order-independent), and only the [N,K] ``broken``
+    # counts need un-permuting — a cheap plane gather, not a second 51 MB
+    # cube gather at 100k peers.
     want_p = jnp.take_along_axis(want, perm[:, :, None], axis=1)
     before = exclusive_or_scan(want_p, axis=1)
     first_p = want_p & ~before                 # one advertiser per id, random order
-    first = jnp.take_along_axis(first_p, inv[:, :, None], axis=1)
-    asked = cap_ihave_packed(first, max_iwant_length)
-    served = asked & _as_mask(serve_ok)[:, :, None]
+    asked_p = cap_ihave_packed(first_p, max_iwant_length)
+    serve_p = jnp.take_along_axis(serve_ok, perm, axis=1)
+    served_p = asked_p & _as_mask(serve_p)[:, :, None]
     pend = jax.lax.reduce(
-        served, jnp.uint32(0), jax.lax.bitwise_or, dimensions=(1,)
+        served_p, jnp.uint32(0), jax.lax.bitwise_or, dimensions=(1,)
     )
-    broken = (
-        jax.lax.population_count(asked & ~_as_mask(serve_ok)[:, :, None])
+    broken_p = (
+        jax.lax.population_count(asked_p & ~_as_mask(serve_p)[:, :, None])
         .sum(axis=-1)
         .astype(jnp.float32)
     )
+    broken = jnp.take_along_axis(broken_p, inv, axis=1)
+    return pend & _as_mask(alive)[:, None], broken
+
+
+def gossip_exchange_packed(
+    key_adv: jax.Array,
+    key_iwant: jax.Array,
+    have_w: jax.Array,       # u32[N, W] advertise source (pre-TTL-scrub)
+    have_dedup_w: jax.Array, # u32[N, W] IWANT dedup view (TTL-scrubbed)
+    mesh: jax.Array,         # bool[N, K]
+    nbrs: jax.Array,         # i32[N, K]
+    rev: jax.Array,          # i32[N, K]
+    edge_live: jax.Array,    # bool[N, K]
+    alive: jax.Array,        # bool[N]
+    scores: jax.Array,       # f32[N, K]
+    gossip_w: jax.Array,     # u32[W] packed advertisable window
+    p: GossipSubParams,
+    gossip_threshold: float,
+    serve_ok: jax.Array,     # bool[N, K]
+    max_iwant_length: int,
+) -> tuple[jax.Array, jax.Array]:
+    """Fused IHAVE advertise + IWANT select -> (pend u32[N, W],
+    broken f32[N, K]).
+
+    Bit-exact with ``iwant_select_packed(ihave_advertise_packed(...), ...)``
+    under the same keys (asserted in ``tests/test_gossip_packed.py``), but
+    the advertisement cube is built DIRECTLY in the receiver's random
+    priority order: all [N, K] planes permute first (cheap), then ONE
+    permuted [N, K, W] row gather feeds the whole chain — the unpermuted
+    cube of the unfused pair (~51 MB at 100k peers) never materializes.
+    The heartbeat's hot path; the unfused pair remains the tested
+    reference.
+    """
+    n, k = nbrs.shape
+    d_lazy = min(p.d_lazy, k)
+    if d_lazy <= 0:
+        return (
+            jnp.zeros_like(have_w),
+            jnp.zeros((n, k), jnp.float32),
+        )
+    chosen = gossip_emission_mask(
+        key_adv, mesh, edge_live, alive, scores, p, gossip_threshold
+    )
+    perm, inv = iwant_priority(key_iwant, n, k)
+    take = lambda x: jnp.take_along_axis(x, perm, axis=1)
+    jidx_p = take(jnp.clip(nbrs, 0, n - 1))
+    ridx_p = take(jnp.clip(rev, 0, k - 1))
+    edge_live_p = take(edge_live)
+    towards_me_p = chosen[jidx_p, ridx_p] & edge_live_p
+    adv_p = (
+        _as_mask(towards_me_p)[:, :, None]
+        & (have_w & gossip_w[None, :])[jidx_p]
+    )
+    adv_p = cap_ihave_packed(adv_p, p.max_ihave_length)
+    accept_p = edge_live_p & (take(scores) >= gossip_threshold)
+    want_p = (
+        adv_p & ~have_dedup_w[:, None, :] & _as_mask(accept_p)[:, :, None]
+    )
+    before = exclusive_or_scan(want_p, axis=1)
+    first_p = want_p & ~before
+    asked_p = cap_ihave_packed(first_p, max_iwant_length)
+    serve_p = take(serve_ok)
+    served_p = asked_p & _as_mask(serve_p)[:, :, None]
+    pend = jax.lax.reduce(
+        served_p, jnp.uint32(0), jax.lax.bitwise_or, dimensions=(1,)
+    )
+    broken_p = (
+        jax.lax.population_count(asked_p & ~_as_mask(serve_p)[:, :, None])
+        .sum(axis=-1)
+        .astype(jnp.float32)
+    )
+    broken = jnp.take_along_axis(broken_p, inv, axis=1)
     return pend & _as_mask(alive)[:, None], broken

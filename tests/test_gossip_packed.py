@@ -157,6 +157,44 @@ def test_two_phase_gossip_packed_matches_reference(seed):
     assert (np.asarray(ref_broken)[np.asarray(serve_ok)] == 0).all()
 
 
+@pytest.mark.parametrize("seed", [0, 3, 11])
+def test_fused_gossip_exchange_matches_unfused_pair(seed):
+    """The fused advertise+select kernel (permuted-cube construction, the
+    heartbeat's hot path) must be bit-exact with the unfused
+    ihave_advertise_packed -> iwant_select_packed chain under the same keys,
+    including a TTL-scrubbed dedup view differing from the advertise view."""
+    mesh, nbrs, rev, valid, alive, have, fresh, msg_valid = _random_state(seed)
+    n, m = have.shape
+    k = nbrs.shape[1]
+    rng = np.random.default_rng(seed)
+    scores = jnp.asarray(rng.normal(0, 1, (n, k)).astype(np.float32))
+    serve_ok = jnp.asarray(rng.random((n, k)) < 0.66)
+    p = GossipSubParams(d_lazy=4)
+    ka, ki = jax.random.PRNGKey(seed), jax.random.PRNGKey(seed + 100)
+    edge_live = jnp.asarray(
+        np.asarray(valid)
+        & np.asarray(alive)[np.clip(np.asarray(nbrs), 0, n - 1)]
+    )
+    have_w = bitpack.pack(have)
+    # Dedup view differs from the advertise view (the seen-TTL scrub).
+    dedup = bitpack.pack(have & jnp.asarray(rng.random((n, m)) < 0.9))
+    gw = bitpack.pack(msg_valid)
+
+    adv = packed_ops.ihave_advertise_packed(
+        ka, have_w, mesh, nbrs, rev, edge_live, alive, scores, gw, p, -0.5
+    )
+    ref_pend, ref_broken = packed_ops.iwant_select_packed(
+        ki, adv, dedup, edge_live, scores, serve_ok, alive,
+        max_iwant_length=40, gossip_threshold=-0.5,
+    )
+    out_pend, out_broken = packed_ops.gossip_exchange_packed(
+        ka, ki, have_w, dedup, mesh, nbrs, rev, edge_live, alive, scores,
+        gw, p, -0.5, serve_ok, 40,
+    )
+    np.testing.assert_array_equal(np.asarray(out_pend), np.asarray(ref_pend))
+    np.testing.assert_allclose(np.asarray(out_broken), np.asarray(ref_broken))
+
+
 def test_ihave_advertise_packed_disabled_when_d_lazy_zero():
     mesh, nbrs, rev, valid, alive, have, fresh, msg_valid = _random_state(1)
     out = packed_ops.ihave_advertise_packed(

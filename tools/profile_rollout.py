@@ -1,8 +1,14 @@
 """Per-phase profile of the 100k-peer GossipSub rollout (VERDICT r3 task 1).
 
 Times each phase of the bench rollout separately on the real device so the
-optimization work targets measured cost, not guesses.  Not part of the test
-suite; run manually:  python tools/profile_rollout.py [n_peers]
+optimization work targets measured cost, not guesses.  Delegates to
+``bench.phase_breakdown`` — the same machinery the bench records into its
+JSON line — which passes every array as a jit ARGUMENT (a closure over
+device arrays becomes a compile-time constant and XLA folds the phase away;
+the original standalone version of this tool had exactly that bug, so its
+historical sub-phase numbers under-measured).
+
+Not part of the test suite; run manually:  python tools/profile_rollout.py [n_peers]
 """
 
 import os
@@ -15,32 +21,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from go_libp2p_pubsub_tpu.config import GossipSubParams, ScoreParams
+from bench import phase_breakdown
 from go_libp2p_pubsub_tpu.models.gossipsub import GossipSub
-from go_libp2p_pubsub_tpu.ops import bitpack
-from go_libp2p_pubsub_tpu.ops import gossip_packed as gossip_ops
-from go_libp2p_pubsub_tpu.ops import scoring as scoring_ops
-from go_libp2p_pubsub_tpu.ops.gossip import heartbeat_mesh, masked_median
-from go_libp2p_pubsub_tpu.ops.px import px_rewire
-
-
-def timeit(name, fn, *args, reps=8):
-    f = jax.jit(fn)
-    out = jax.block_until_ready(f(*args))  # compile
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = f(*args)
-    jax.block_until_ready(out)
-    dt = (time.perf_counter() - t0) / reps * 1e3
-    print(f"{name:38s} {dt:8.2f} ms")
-    return dt
 
 
 def main():
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
     gs = GossipSub(n_peers=n, n_slots=32, conn_degree=16, msg_window=128)
-    p, sp = gs.params, gs.score_params
-    print(f"device: {jax.devices()[0].device_kind}  n={n}")
+    print(f"device: {jax.devices()[0].device_kind}  n={n}  "
+          f"kernel={'pallas' if gs.use_pallas else 'jnp'}")
     t0 = time.perf_counter()
     st = gs.init(seed=0)
     jax.block_until_ready(st.mesh)
@@ -51,70 +40,8 @@ def main():
                         jnp.asarray(True))
     st = jax.block_until_ready(gs.run(st, 4))  # realistic mid-rollout state
 
-    # --- full step / propagate / heartbeat -------------------------------
-    timeit("full step", gs.step, st)
-    timeit("propagate only", gs._propagate, st)
-    timeit("heartbeat only", gs._heartbeat, st)
-
-    # --- propagate subphases ---------------------------------------------
-    valid_w = bitpack.pack(st.msg_valid & st.msg_active)
-    relay_mesh = st.mesh & (st.scores >= sp.graylist_threshold)
-    if gs.use_pallas:
-        from go_libp2p_pubsub_tpu.ops.pallas_gossip import propagate_packed_pallas
-        timeit("  pallas propagate kernel",
-               lambda: propagate_packed_pallas(
-                   relay_mesh, st.nbrs, st.edge_live, st.alive, st.have_w,
-                   st.fresh_w, valid_w, interpret=False))
-    timeit("  jnp propagate kernel",
-           lambda: gossip_ops.propagate_packed(
-               relay_mesh, st.nbrs, st.edge_live, st.alive, st.have_w,
-               st.fresh_w, valid_w))
-    timeit("  first_step stamp x1",
-           lambda: jnp.where(
-               bitpack.unpack(st.fresh_w, gs.m) & (st.first_step < 0),
-               st.step, st.first_step))
-
-    # --- heartbeat subphases ---------------------------------------------
-    def scores_fn():
-        c = scoring_ops.tick_mesh_clocks(st.counters, st.mesh,
-                                         p.heartbeat_interval_s)
-        c = scoring_ops.decay_topic_counters(c, sp)
-        g = scoring_ops.decay_global_counters(st.gcounters, sp)
-        return scoring_ops.neighbor_scores(c, g, st.nbrs, st.nbr_valid, sp)
-    timeit("  score refresh", scores_fn)
-    scores = jax.jit(scores_fn)()
-    part = st.alive & st.subscribed
-    edge_ok = st.edge_live & st.nbr_sub
-    key = jax.random.PRNGKey(1)
-    timeit("  heartbeat_mesh", lambda: heartbeat_mesh(
-        key, st.mesh, scores, st.nbrs, st.rev, edge_ok, part, p,
-        st.backoff, st.outbound, False,
-        og_threshold=sp.opportunistic_graft_threshold))
-    timeit("  masked_median alone",
-           lambda: masked_median(scores, st.mesh))
-    nm, gr, pr, bo, bv = jax.jit(lambda: heartbeat_mesh(
-        key, st.mesh, scores, st.nbrs, st.rev, edge_ok, part, p,
-        st.backoff, st.outbound, False,
-        og_threshold=sp.opportunistic_graft_threshold))()
-    timeit("  px_rewire", lambda: px_rewire(
-        key, st.nbrs, st.rev, st.nbr_valid, st.outbound, bo, nm, pr,
-        scores, st.alive, sp.accept_px_threshold))
-    gossip_w = bitpack.pack(st.msg_valid & st.msg_active)
-    timeit("  ihave_advertise_packed", lambda: gossip_ops.ihave_advertise_packed(
-        key, st.have_w, nm, st.nbrs, st.rev, st.edge_live & st.nbr_sub,
-        part, scores, gossip_w, p, sp.gossip_threshold))
-
-    from go_libp2p_pubsub_tpu.ops.graphs import safe_gather
-
-    def ihave_iwant():
-        adv = gossip_ops.ihave_advertise_packed(
-            key, st.have_w, nm, st.nbrs, st.rev, st.edge_live & st.nbr_sub,
-            part, scores, gossip_w, p, sp.gossip_threshold)
-        serve_ok = ~safe_gather(st.gossip_mute, st.nbrs, True)
-        return gossip_ops.iwant_select_packed(
-            key, adv, st.have_w, st.edge_live & st.nbr_sub, scores, serve_ok,
-            part, p.max_iwant_length, sp.gossip_threshold)
-    timeit("  ihave+iwant_select fused", ihave_iwant)
+    for name, ms in phase_breakdown(gs, st, reps=8).items():
+        print(f"{name:24s} {ms:9.2f} ms")
 
 
 if __name__ == "__main__":
