@@ -697,11 +697,21 @@ class GossipSub:
         # priority order (one [N,K,W] gather; bit-exact with the unfused
         # advertise+select pair, which stays as the tested reference).
         serve_ok = ~safe_gather(st.gossip_mute, px.nbrs, True)
-        iwant_pend_w, broken = gossip_ops.gossip_exchange_packed(
+        exchange_args = (
             kgossip, kiwant, st.have_w, have_w, new_mesh, px.nbrs, px.rev,
             edge_live & nbr_sub, part, scores, gossip_w, p,
             sp.gossip_threshold, serve_ok, p.max_iwant_length,
         )
+        if self.use_pallas and self.pallas_shard_mesh is None:
+            from ..ops.pallas_gossip import gossip_exchange_packed_pallas
+
+            iwant_pend_w, broken = gossip_exchange_packed_pallas(
+                *exchange_args, interpret=jax.default_backend() != "tpu",
+            )
+        else:
+            iwant_pend_w, broken = gossip_ops.gossip_exchange_packed(
+                *exchange_args
+            )
         # P7: broken promises charge the ADVERTISER (indexed by remote id).
         promise_ids = jnp.where(
             px.nbr_valid, px.nbrs, self.n

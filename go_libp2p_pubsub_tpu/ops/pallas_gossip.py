@@ -178,6 +178,197 @@ def propagate_packed_pallas(
     )
 
 
+def _exchange_kernel(
+    adv_ref,     # u32[T, K*W] gathered advertisement words, slot-major, UNCAPPED
+    have_ref,    # u32[T, W]   IWANT dedup view (seen-TTL scrubbed)
+    accept_ref,  # u32[T, K*W] per-slot accept mask broadcast over W lanes
+    serve_ref,   # u32[T, K*W] per-slot serve mask broadcast over W lanes
+    alive_ref,   # u32[T, 1]
+    lis_ref,     # i32[1, K*W] lane position within its W-lane slot group
+    gmat_ref,    # f32[K*W, K] slot group-sum matrix
+    pend_o,      # u32[T, W]
+    broken_o,    # f32[T, K]
+    *,
+    max_ihave: int,
+    max_iwant: int,
+):
+    t, w = have_ref.shape
+    l = adv_ref.shape[1]
+    k = l // w
+
+    # Lane-in-slot positions ride in as data (host-precomputed iota%W):
+    # no reliance on Mosaic lowering of iota/rem.
+    lane_in_slot = jnp.broadcast_to(lis_ref[:], (t, l))
+
+    def cap_words(x, max_len):
+        # Word-granular per-slot cap: keep lane (slot s, word w') while the
+        # slot's cumulative popcount through w' fits.  Hillis-Steele prefix
+        # sum within each W-lane slot group (shifts masked at boundaries).
+        pc = jax.lax.population_count(x).astype(jnp.int32)
+        cum = pc
+        sh = 1
+        while sh < w:
+            shifted = jnp.concatenate(
+                [jnp.zeros((t, sh), jnp.int32), cum[:, : l - sh]], axis=1
+            )
+            cum = cum + jnp.where(lane_in_slot >= sh, shifted, 0)
+            sh *= 2
+        # np scalars are literals (a jnp scalar would be a captured constant,
+        # which pallas_call rejects).
+        return x & jnp.where(
+            cum <= max_len, np.uint32(0xFFFFFFFF), np.uint32(0)
+        )
+
+    adv = cap_words(adv_ref[:], max_ihave)
+    have_rep = pltpu.repeat(have_ref[:], k, axis=1)
+    want = adv & ~have_rep & accept_ref[:]
+
+    # Exclusive prefix-OR over slot groups -> first advertising slot per id
+    # (slots arrive PRE-PERMUTED in the receiver's random priority order).
+    p = want
+    sh = 1
+    while sh < k:
+        shifted = jnp.concatenate(
+            [jnp.zeros((t, sh * w), jnp.uint32), p[:, : l - sh * w]], axis=1
+        )
+        p = p | shifted
+        sh *= 2
+    before = jnp.concatenate(
+        [jnp.zeros((t, w), jnp.uint32), p[:, : l - w]], axis=1
+    )
+    first = want & ~before
+
+    asked = cap_words(first, max_iwant)
+    served = asked & serve_ref[:]
+
+    # pend = OR over slots per word: inclusive prefix-OR's last slot group.
+    ps = served
+    sh = 1
+    while sh < k:
+        shifted = jnp.concatenate(
+            [jnp.zeros((t, sh * w), jnp.uint32), ps[:, : l - sh * w]], axis=1
+        )
+        ps = ps | shifted
+        sh *= 2
+    pend_o[:] = ps[:, l - w :] & alive_ref[:]
+
+    pc = lambda x: jax.lax.population_count(x).astype(jnp.int32).astype(jnp.float32)
+    broken_o[:] = jnp.dot(
+        pc(asked & ~serve_ref[:]), gmat_ref[:],
+        preferred_element_type=jnp.float32,
+    )
+
+
+def gossip_exchange_packed_pallas(
+    key_adv: jax.Array,
+    key_iwant: jax.Array,
+    have_w: jax.Array,       # u32[N, W] advertise source (pre-TTL-scrub)
+    have_dedup_w: jax.Array, # u32[N, W] IWANT dedup view (TTL-scrubbed)
+    mesh: jax.Array,         # bool[N, K]
+    nbrs: jax.Array,         # i32[N, K]
+    rev: jax.Array,          # i32[N, K]
+    edge_live: jax.Array,    # bool[N, K]
+    alive: jax.Array,        # bool[N]
+    scores: jax.Array,       # f32[N, K]
+    gossip_w: jax.Array,     # u32[W]
+    p,                       # GossipSubParams
+    gossip_threshold: float,
+    serve_ok: jax.Array,     # bool[N, K]
+    max_iwant_length: int,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """Fused-kernel form of ``gossip_packed.gossip_exchange_packed`` — the
+    heartbeat's IHAVE advertise + IWANT select in one Pallas pass.
+
+    The jnp fused form materializes the permuted [N, K, W] cube four more
+    times after the gather (ihave cap, want, prefix-OR, ask cap); here all
+    post-gather cube compute happens in VMEM tiles: per-slot word-granular
+    caps via boundary-masked Hillis-Steele prefix sums, first-advertiser
+    selection via the same coarse-lane prefix-OR as the propagate kernel,
+    promise counts via the group-sum matmul.  Cube-shaped HBM traffic
+    that remains: the gathered advertisement input plus the accept/serve
+    lane masks (three kernel inputs) — still well under the jnp form's
+    intermediate materializations.  Bit-exact with the jnp forms
+    (``tests/test_pallas_gossip.py``).
+
+    Single-chip fast path only (like ``propagate_packed_pallas``); the
+    sharded runner's heartbeat stays on the GSPMD-partitioned jnp form.
+    """
+    from .gossip import gossip_emission_mask, iwant_priority
+
+    n, k = nbrs.shape
+    w = have_w.shape[1]
+    l = k * w
+    d_lazy = min(p.d_lazy, k)
+    if d_lazy <= 0:
+        return (
+            jnp.zeros_like(have_w),
+            jnp.zeros((n, k), jnp.float32),
+        )
+
+    chosen = gossip_emission_mask(
+        key_adv, mesh, edge_live, alive, scores, p, gossip_threshold
+    )
+    perm, inv = iwant_priority(key_iwant, n, k)
+    take = lambda x: jnp.take_along_axis(x, perm, axis=1)
+    jidx_p = take(jnp.clip(nbrs, 0, n - 1))
+    ridx_p = take(jnp.clip(rev, 0, k - 1))
+    edge_live_p = take(edge_live)
+    towards_me_p = chosen[jidx_p, ridx_p] & edge_live_p
+    adv_p = (
+        _as_mask(towards_me_p)[:, :, None]
+        & (have_w & gossip_w[None, :])[jidx_p]
+    ).reshape(n, l)
+    accept_p = edge_live_p & (take(scores) >= gossip_threshold)
+    accept_l = jnp.repeat(_as_mask(accept_p), w, axis=1)
+    serve_l = jnp.repeat(_as_mask(take(serve_ok)), w, axis=1)
+    alive_m = _as_mask(alive)[:, None]
+    have_in = have_dedup_w
+
+    pad = (-n) % TILE
+    if pad:
+        zrow = lambda x: jnp.zeros((pad,) + x.shape[1:], x.dtype)
+        adv_p = jnp.concatenate([adv_p, zrow(adv_p)])
+        have_in = jnp.concatenate([have_in, zrow(have_in)])
+        accept_l = jnp.concatenate([accept_l, zrow(accept_l)])
+        serve_l = jnp.concatenate([serve_l, zrow(serve_l)])
+        alive_m = jnp.concatenate([alive_m, zrow(alive_m)])
+    n_pad = n + pad
+
+    gmat = np.zeros((l, k), np.float32)
+    for s in range(k):
+        gmat[s * w : (s + 1) * w, s] = 1.0
+
+    row_block = lambda width: pl.BlockSpec(
+        (TILE, width), lambda i: (i, 0), memory_space=pltpu.VMEM
+    )
+    pend_p, broken_p = pl.pallas_call(
+        functools.partial(
+            _exchange_kernel,
+            max_ihave=p.max_ihave_length,
+            max_iwant=max_iwant_length,
+        ),
+        grid=(n_pad // TILE,),
+        in_specs=[
+            row_block(l), row_block(w), row_block(l), row_block(l),
+            row_block(1),
+            pl.BlockSpec((1, l), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((l, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=(row_block(w), row_block(k)),
+        out_shape=(
+            jax.ShapeDtypeStruct((n_pad, w), jnp.uint32),
+            jax.ShapeDtypeStruct((n_pad, k), jnp.float32),
+        ),
+        interpret=interpret,
+    )(adv_p, have_in, accept_l, serve_l, alive_m,
+      jnp.asarray(np.arange(l, dtype=np.int32) % w)[None, :],
+      jnp.asarray(gmat))
+
+    broken = jnp.take_along_axis(broken_p[:n], inv, axis=1)
+    return pend_p[:n], broken
+
+
 def propagate_packed_pallas_sharded(
     device_mesh,           # jax.sharding.Mesh with a peer axis
     mesh: jax.Array,       # bool[N, K]

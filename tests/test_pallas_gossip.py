@@ -109,3 +109,68 @@ def test_pallas_matches_reference_with_fresh_src():
     np.testing.assert_array_equal(np.asarray(out.new_w), np.asarray(ref.new_w))
     np.testing.assert_array_equal(np.asarray(out.fmd_inc), np.asarray(ref.fmd_inc))
     np.testing.assert_array_equal(np.asarray(out.mmd_inc), np.asarray(ref.mmd_inc))
+
+
+@pytest.mark.parametrize("seed,n", [(0, TILE), (1, 200), (2, TILE + 77)])
+def test_pallas_gossip_exchange_matches_jnp_fused(seed, n):
+    """The Pallas IHAVE+IWANT exchange kernel must be bit-exact with the jnp
+    fused form (which is itself bit-exact with the unfused tested pair)
+    under the same keys, including distinct advertise/dedup views and
+    promise-breaking advertisers."""
+    from go_libp2p_pubsub_tpu.config import GossipSubParams
+    from go_libp2p_pubsub_tpu.models.gossipsub import build_topology as bt
+    from go_libp2p_pubsub_tpu.ops.pallas_gossip import (
+        gossip_exchange_packed_pallas,
+    )
+    import jax
+
+    k, m = 32, 128
+    rng = np.random.default_rng(seed)
+    nbrs, rev, valid, _ = bt(rng, n, k, 12)
+    mesh = valid & (rng.random((n, k)) < 0.5)
+    j = np.clip(nbrs, 0, n - 1)
+    mesh = mesh & mesh[j, np.clip(rev, 0, k - 1)]
+    alive = jnp.asarray(rng.random(n) < 0.9)
+    have = rng.random((n, m)) < 0.3
+    dedup = have & (rng.random((n, m)) < 0.9)
+    scores = jnp.asarray(rng.normal(0, 1, (n, k)).astype(np.float32))
+    serve_ok = jnp.asarray(rng.random((n, k)) < 0.66)
+    gw = bitpack.pack(jnp.asarray(rng.random(m) < 0.8))
+    p = GossipSubParams(d_lazy=6, max_ihave_length=70)
+    ka, ki = jax.random.PRNGKey(seed), jax.random.PRNGKey(seed + 50)
+    edge_live = jnp.asarray(valid & np.asarray(alive)[j])
+    args = (
+        ka, ki, bitpack.pack(jnp.asarray(have)),
+        bitpack.pack(jnp.asarray(dedup)), jnp.asarray(mesh),
+        jnp.asarray(nbrs, jnp.int32), jnp.asarray(rev, jnp.int32),
+        edge_live, alive, scores, gw, p, -0.5, serve_ok, 40,
+    )
+    ref_pend, ref_broken = gossip_packed.gossip_exchange_packed(*args)
+    out_pend, out_broken = gossip_exchange_packed_pallas(
+        *args, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(out_pend), np.asarray(ref_pend))
+    np.testing.assert_array_equal(
+        np.asarray(out_broken), np.asarray(ref_broken)
+    )
+
+
+def test_model_rollout_pallas_path_matches_jnp_path():
+    """Full-model cross-check: a rollout on the all-Pallas path (propagate
+    kernel + exchange kernel, interpret mode on CPU) is leaf-for-leaf
+    bit-identical with the jnp path — the heartbeat's kernel choice must
+    not alter a single bit of protocol state."""
+    import jax
+
+    from go_libp2p_pubsub_tpu.models.gossipsub import GossipSub
+
+    kw = dict(n_peers=200, n_slots=16, conn_degree=12, msg_window=64)
+    ga = GossipSub(use_pallas=False, **kw)
+    gb = GossipSub(use_pallas=True, **kw)   # off-TPU -> interpret mode
+    sa, sb = ga.init(seed=3), gb.init(seed=3)
+    for s in range(4):
+        sa = ga.publish(sa, jnp.int32(s * 7), jnp.int32(s), jnp.asarray(True))
+        sb = gb.publish(sb, jnp.int32(s * 7), jnp.int32(s), jnp.asarray(True))
+    sa, sb = ga.run(sa, 18), gb.run(sb, 18)
+    for la, lb in zip(jax.tree.leaves(sa), jax.tree.leaves(sb)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
