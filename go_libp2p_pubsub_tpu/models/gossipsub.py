@@ -702,11 +702,15 @@ class GossipSub:
             edge_live & nbr_sub, part, scores, gossip_w, p,
             sp.gossip_threshold, serve_ok, p.max_iwant_length,
         )
-        if self.use_pallas and self.pallas_shard_mesh is None:
+        if self.use_pallas:
             from ..ops.pallas_gossip import gossip_exchange_packed_pallas
 
+            # The kernel's XLA prep partitions under GSPMD, so the sharded
+            # runner passes its device mesh and the row-local kernel runs
+            # under shard_map.
             iwant_pend_w, broken = gossip_exchange_packed_pallas(
                 *exchange_args, interpret=jax.default_backend() != "tpu",
+                device_mesh=self.pallas_shard_mesh,
             )
         else:
             iwant_pend_w, broken = gossip_ops.gossip_exchange_packed(

@@ -45,6 +45,31 @@ from .gossip_packed import PropagatePackedOut, _as_mask
 TILE = 512
 
 
+def _pad_rows(n, *arrays):
+    """Pad every array's leading dim from n up to the next TILE multiple
+    (zero rows); returns (n_pad, padded_arrays)."""
+    pad = (-n) % TILE
+    if not pad:
+        return n, arrays
+    zrow = lambda x: jnp.zeros((pad,) + x.shape[1:], x.dtype)
+    return n + pad, tuple(jnp.concatenate([x, zrow(x)]) for x in arrays)
+
+
+def _group_sum_matrix(l, k):
+    """f32[K*W, K] 0/1 matrix summing each slot's W lanes (popcounts ride
+    the MXU as a matmul instead of a strided reduction)."""
+    w = l // k
+    gmat = np.zeros((l, k), np.float32)
+    for s in range(k):
+        gmat[s * w : (s + 1) * w, s] = 1.0
+    return jnp.asarray(gmat)
+
+
+def _row_block(width):
+    return pl.BlockSpec((TILE, width), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+
+
 def _propagate_kernel(
     inc_ref,    # u32[T, K*W] gathered neighbor fresh words, edge-masked
     have_ref,   # u32[T, W]
@@ -129,23 +154,8 @@ def propagate_packed_pallas(
     inc = jnp.where(edge_ok[:, :, None], src, jnp.uint32(0)).reshape(n, l)
     alive_m = _as_mask(alive)[:, None]
 
-    pad = (-n) % TILE
-    if pad:
-        zrow = lambda x: jnp.zeros((pad,) + x.shape[1:], x.dtype)
-        inc = jnp.concatenate([inc, zrow(inc)])
-        have_in = jnp.concatenate([have_w, zrow(have_w)])
-        alive_m = jnp.concatenate([alive_m, zrow(alive_m)])
-    else:
-        have_in = have_w
-    n_pad = n + pad
+    n_pad, (inc, have_in, alive_m) = _pad_rows(n, inc, have_w, alive_m)
 
-    gmat = np.zeros((l, k), np.float32)
-    for s in range(k):
-        gmat[s * w : (s + 1) * w, s] = 1.0
-
-    row_block = lambda width: pl.BlockSpec(
-        (TILE, width), lambda i: (i, 0), memory_space=pltpu.VMEM
-    )
     full = lambda shape: pl.BlockSpec(
         shape, lambda i: (0, 0), memory_space=pltpu.VMEM
     )
@@ -153,12 +163,12 @@ def propagate_packed_pallas(
         _propagate_kernel,
         grid=(n_pad // TILE,),
         in_specs=[
-            row_block(l), row_block(w), row_block(1),
+            _row_block(l), _row_block(w), _row_block(1),
             full((1, w)), full((l, k)),
         ],
         out_specs=(
-            row_block(w), row_block(w), row_block(w),
-            row_block(k), row_block(k), row_block(k),
+            _row_block(w), _row_block(w), _row_block(w),
+            _row_block(k), _row_block(k), _row_block(k),
         ),
         out_shape=(
             jax.ShapeDtypeStruct((n_pad, w), jnp.uint32),
@@ -169,7 +179,7 @@ def propagate_packed_pallas(
             jax.ShapeDtypeStruct((n_pad, k), jnp.float32),
         ),
         interpret=interpret,
-    )(inc, have_in, alive_m, valid_w[None, :], jnp.asarray(gmat))
+    )(inc, have_in, alive_m, valid_w[None, :], _group_sum_matrix(l, k))
 
     have_o, fresh_o, new_o, fmd, mmd, inv = (x[:n] for x in outs)
     return PropagatePackedOut(
@@ -276,6 +286,9 @@ def gossip_exchange_packed_pallas(
     serve_ok: jax.Array,     # bool[N, K]
     max_iwant_length: int,
     interpret: bool = False,
+    device_mesh=None,        # jax.sharding.Mesh: run the kernel under
+                             # shard_map over ``axis`` (peer-sharded sim)
+    axis: str = "peers",
 ) -> tuple[jax.Array, jax.Array]:
     """Fused-kernel form of ``gossip_packed.gossip_exchange_packed`` — the
     heartbeat's IHAVE advertise + IWANT select in one Pallas pass.
@@ -291,8 +304,12 @@ def gossip_exchange_packed_pallas(
     intermediate materializations.  Bit-exact with the jnp forms
     (``tests/test_pallas_gossip.py``).
 
-    Single-chip fast path only (like ``propagate_packed_pallas``); the
-    sharded runner's heartbeat stays on the GSPMD-partitioned jnp form.
+    The random-priority prep (emission choice, permutation, global row
+    gathers) runs in plain XLA — it partitions under GSPMD — so the same
+    function also serves the peer-sharded sim: pass ``device_mesh`` and the
+    row-local kernel runs under ``shard_map`` with every input sharded on
+    the peer axis (no collectives inside; the gathers already became
+    collectives in the XLA prep).
     """
     from .gossip import gossip_emission_mask, iwant_priority
 
@@ -323,39 +340,52 @@ def gossip_exchange_packed_pallas(
     accept_l = jnp.repeat(_as_mask(accept_p), w, axis=1)
     serve_l = jnp.repeat(_as_mask(take(serve_ok)), w, axis=1)
     alive_m = _as_mask(alive)[:, None]
-    have_in = have_dedup_w
 
-    pad = (-n) % TILE
-    if pad:
-        zrow = lambda x: jnp.zeros((pad,) + x.shape[1:], x.dtype)
-        adv_p = jnp.concatenate([adv_p, zrow(adv_p)])
-        have_in = jnp.concatenate([have_in, zrow(have_in)])
-        accept_l = jnp.concatenate([accept_l, zrow(accept_l)])
-        serve_l = jnp.concatenate([serve_l, zrow(serve_l)])
-        alive_m = jnp.concatenate([alive_m, zrow(alive_m)])
-    n_pad = n + pad
-
-    gmat = np.zeros((l, k), np.float32)
-    for s in range(k):
-        gmat[s * w : (s + 1) * w, s] = 1.0
-
-    row_block = lambda width: pl.BlockSpec(
-        (TILE, width), lambda i: (i, 0), memory_space=pltpu.VMEM
+    call = functools.partial(
+        _exchange_call,
+        w=w,
+        max_ihave=p.max_ihave_length,
+        max_iwant=max_iwant_length,
+        interpret=interpret,
     )
+    if device_mesh is not None:
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        rows = P(axis, None)
+        call = shard_map(
+            call, mesh=device_mesh,
+            in_specs=(rows, rows, rows, rows, rows),
+            out_specs=(rows, rows),
+            check_vma=False,
+        )
+    pend, broken_p = call(adv_p, have_dedup_w, accept_l, serve_l, alive_m)
+    broken = jnp.take_along_axis(broken_p, inv, axis=1)
+    return pend, broken
+
+
+def _exchange_call(adv_p, have_in, accept_l, serve_l, alive_m, *, w,
+                   max_ihave, max_iwant, interpret):
+    """Row-local pallas_call for the exchange kernel (pads its own block to
+    TILE rows, so it works unchanged on a full table or one shard)."""
+    n, l = adv_p.shape
+    k = l // w
+    n_pad, (adv_p, have_in, accept_l, serve_l, alive_m) = _pad_rows(
+        n, adv_p, have_in, accept_l, serve_l, alive_m
+    )
+
     pend_p, broken_p = pl.pallas_call(
         functools.partial(
-            _exchange_kernel,
-            max_ihave=p.max_ihave_length,
-            max_iwant=max_iwant_length,
+            _exchange_kernel, max_ihave=max_ihave, max_iwant=max_iwant,
         ),
         grid=(n_pad // TILE,),
         in_specs=[
-            row_block(l), row_block(w), row_block(l), row_block(l),
-            row_block(1),
+            _row_block(l), _row_block(w), _row_block(l), _row_block(l),
+            _row_block(1),
             pl.BlockSpec((1, l), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((l, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ],
-        out_specs=(row_block(w), row_block(k)),
+        out_specs=(_row_block(w), _row_block(k)),
         out_shape=(
             jax.ShapeDtypeStruct((n_pad, w), jnp.uint32),
             jax.ShapeDtypeStruct((n_pad, k), jnp.float32),
@@ -363,10 +393,8 @@ def gossip_exchange_packed_pallas(
         interpret=interpret,
     )(adv_p, have_in, accept_l, serve_l, alive_m,
       jnp.asarray(np.arange(l, dtype=np.int32) % w)[None, :],
-      jnp.asarray(gmat))
-
-    broken = jnp.take_along_axis(broken_p[:n], inv, axis=1)
-    return pend_p[:n], broken
+      _group_sum_matrix(l, k))
+    return pend_p[:n], broken_p[:n]
 
 
 def propagate_packed_pallas_sharded(

@@ -1,6 +1,6 @@
 """Property-based tests (SURVEY §5.2; r2/r3/r4 verdict order).
 
-Three hypothesis suites over the subsystems whose input spaces are too big
+Four hypothesis suites over the subsystems whose input spaces are too big
 for example tests:
 
 (a) wire codec — round-trip + incremental framing at arbitrary chunk
@@ -11,11 +11,17 @@ for example tests:
     join/kill/leave ``FaultPlan``s;
 (c) ``_BatchValidator`` — delivered payloads and order are a pure function
     of the submitted frames, independent of backend latency and batch
-    boundaries (the verdict-order identity of ``net/live.py:94-163``).
+    boundaries (the verdict-order identity of ``net/live.py:94-163``);
+(d) gossip mesh state machine — structural invariants (mesh symmetry,
+    membership gating, backoff sanity, bitpack padding) under random
+    publish/kill/subscribe/rollout schedules (slow tier: each drawn
+    rollout length is a fresh XLA compile).
 """
 
 import asyncio
 import time
+
+import pytest
 
 import jax.numpy as jnp
 import numpy as np
@@ -305,3 +311,83 @@ def test_batch_validator_order_identity_under_delays(data):
     )
     # Relay gating matches delivery: exactly the delivered frames forwarded.
     assert n_forwarded == len(expected)
+
+
+# ---------------------------------------------------------------------------
+# (d) gossip mesh invariants under random event schedules
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@given(st.data())
+@settings(max_examples=10, deadline=None)
+def test_gossip_mesh_invariants_under_random_events(data):
+    """After any schedule of publishes, kills, subscription flips, and
+    rollout lengths, the mesh state machine's structural invariants hold:
+
+    1. mesh symmetry over the slot pairing (mesh[i,s] == mesh[j, rev[i,s]]);
+    2. mesh edges only between alive+subscribed endpoints on valid slots;
+    3. backoff counters never negative;
+    4. packed possession bits beyond the window stay zero (bitpack padding
+       invariant the popcount counters rely on).
+    """
+    from go_libp2p_pubsub_tpu.models.gossipsub import GossipSub
+    from go_libp2p_pubsub_tpu.ops import bitpack
+
+    n, k, m = 64, 16, 32
+    gs = GossipSub(n_peers=n, n_slots=k, conn_degree=10, msg_window=m,
+                   use_pallas=False)
+    s = gs.init(seed=data.draw(st.integers(0, 5), label="seed"))
+    n_events = data.draw(st.integers(1, 5), label="n_events")
+    slot = 0
+    for _ in range(n_events):
+        kind = data.draw(
+            st.sampled_from(["publish", "kill", "unsub", "run"]), label="kind"
+        )
+        if kind == "publish":
+            s = gs.publish(
+                s,
+                jnp.int32(data.draw(st.integers(0, n - 1), label="src")),
+                jnp.int32(slot % m),
+                jnp.asarray(data.draw(st.booleans(), label="valid")),
+            )
+            slot += 1
+        elif kind == "kill":
+            victims = data.draw(
+                st.lists(st.integers(0, n - 1), max_size=4, unique=True),
+                label="victims",
+            )
+            mask = np.zeros(n, bool)
+            mask[victims] = True
+            s = gs.kill_peers(s, jnp.asarray(mask))
+        elif kind == "unsub":
+            subs = np.asarray(
+                data.draw(
+                    st.lists(st.booleans(), min_size=n, max_size=n),
+                    label="submask",
+                )
+            )
+            subs[0] = True  # keep at least one member
+            s = gs.set_subscribed(s, jnp.asarray(subs))
+        else:
+            s = gs.run(s, data.draw(st.integers(1, 10), label="steps"))
+    s = gs.run(s, gs.heartbeat_steps)  # at least one heartbeat after events
+
+    mesh = np.asarray(s.mesh)
+    nbrs = np.asarray(s.nbrs)
+    rev = np.asarray(s.rev)
+    valid = np.asarray(s.nbr_valid)
+    alive = np.asarray(s.alive)
+    sub = np.asarray(s.subscribed)
+
+    assert not (mesh & ~valid).any(), "mesh on an unwired slot"
+    ii, ss = np.nonzero(mesh)
+    jj, rr = nbrs[ii, ss], rev[ii, ss]
+    np.testing.assert_array_equal(mesh[jj, rr], True, err_msg="asymmetric mesh")
+    member = alive & sub
+    assert member[ii].all() and member[jj].all(), (
+        "mesh edge touching a dead/unsubscribed peer"
+    )
+    assert (np.asarray(s.backoff) >= 0).all()
+    full = np.asarray(bitpack.unpack(s.have_w, gs.w * 32))
+    assert not full[:, m:].any(), "padding bits leaked into have_w"
