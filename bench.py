@@ -461,8 +461,26 @@ def child_main() -> None:
 
     rollout = lambda s: gs.run(s, ROLLOUT_STEPS)
     t0 = time.perf_counter()
-    warm = rollout(st)  # compile
-    jax.block_until_ready(warm.have_w)
+    try:
+        warm = rollout(st)  # compile
+        jax.block_until_ready(warm.have_w)
+    except Exception as e:  # noqa: BLE001 — any Mosaic/compile failure
+        # The Pallas kernels are equivalence-tested in interpret mode but a
+        # Mosaic lowering regression on the real chip must cost us the fast
+        # kernel, not the whole on-chip number: retry the rollout on the
+        # portable jnp kernels (the state is kernel-independent).
+        if not gs.use_pallas:
+            raise
+        log(f"pallas rollout failed to compile ({type(e).__name__}: "
+            f"{str(e)[:200]}); retrying with jnp kernels")
+        gs = GossipSub(
+            n_peers=n_peers, n_slots=scale["n_slots"],
+            conn_degree=scale["degree"], msg_window=N_MSGS,
+            use_pallas=False,
+        )
+        rollout = lambda s: gs.run(s, ROLLOUT_STEPS)
+        warm = rollout(st)
+        jax.block_until_ready(warm.have_w)
     compile_s = time.perf_counter() - t0
     log(f"compile+warm rollout: {compile_s:.1f}s")
 

@@ -23,11 +23,15 @@ row gather ``fresh_w[nbrs]`` (random access by construction; Mosaic has no
 vector gather from VMEM tables) and the edge-liveness masking, which rides
 the gather's output write for free.
 
-Single-chip fast path only: under GSPMD peer-sharding the jnp reference in
-``gossip_packed`` partitions automatically and stays the right choice, so
+Both kernels also serve the GSPMD peer-sharded sim: a bare ``pallas_call``
+does not partition, so ``propagate_packed_pallas_sharded`` wraps the
+propagate kernel in ``shard_map`` (all-gathering the small fresh table),
+and ``gossip_exchange_packed_pallas`` accepts a ``device_mesh`` to run its
+row-local kernel per shard (its XLA prep partitions on its own).
 ``models.gossipsub.GossipSub`` picks per backend (``use_pallas`` arg).
-Equivalence with the reference is asserted bit-for-bit in
-``tests/test_pallas_gossip.py`` (interpret mode on CPU, compiled on TPU).
+Equivalence with the jnp references is asserted bit-for-bit in
+``tests/test_pallas_gossip.py`` / ``tests/test_gossip_sharded.py``
+(interpret mode on CPU, compiled on TPU).
 """
 
 from __future__ import annotations

@@ -8,6 +8,7 @@ import pytest
 
 pytestmark = pytest.mark.slow
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -15,6 +16,17 @@ from go_libp2p_pubsub_tpu.models.gossipsub import build_topology
 from go_libp2p_pubsub_tpu.ops import bitpack
 from go_libp2p_pubsub_tpu.ops import gossip_packed
 from go_libp2p_pubsub_tpu.ops.pallas_gossip import TILE, propagate_packed_pallas
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _fresh_compile_caches():
+    """Interpret-mode kernels inside scan/cond inline enormous HLO; after
+    ~130 prior in-process tests the XLA CPU compiler has been observed to
+    SEGFAULT compiling the model-level tests here (compile-state pressure —
+    each passes standalone).  Dropping the accumulated jit caches before
+    this module keeps the full-suite run inside the compiler's envelope."""
+    jax.clear_caches()
+    yield
 
 
 def _state(seed, n, k=32, m=128, degree=12):
