@@ -127,6 +127,15 @@ class GossipSubParams:
     prune_backoff_heartbeats: int = 4  # spec's PruneBackoff, in heartbeats
     flood_publish: bool = True  # own publishes go to ALL topic peers above
     #                             publish_threshold (go-gossipsub default)
+    idontwant: bool = False  # gossipsub v1.2 IDONTWANT: on first receipt a
+    #                          peer tells its mesh neighbors, who then skip
+    #                          relaying it the copy — in the lockstep model
+    #                          a sender's knowledge is exactly the
+    #                          receiver's previous-round possession, so
+    #                          suppression masks the duplicate copies that
+    #                          would have crossed the wire (observable as
+    #                          lower P3 mesh-delivery counting; deliveries,
+    #                          receipts, and all other state are unchanged)
 
     def __post_init__(self) -> None:
         if not (self.d_lo <= self.d <= self.d_hi):

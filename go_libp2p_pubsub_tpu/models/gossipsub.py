@@ -802,6 +802,12 @@ class GossipSub:
             ]
         else:
             fresh_src = None
+        # IDONTWANT suppression must see the receiver's PRE-FOLD possession
+        # (st.have_w): the notifications are one hop old, so a message that
+        # folded in via IWANT/flood THIS round races the eager copy and its
+        # duplicate still crosses the wire (gossip.propagate's documented
+        # one-round-delay semantics).
+        idw = st.have_w if self.params.idontwant else None
         if self.use_pallas and self.pallas_shard_mesh is not None:
             from ..ops.pallas_gossip import propagate_packed_pallas_sharded
 
@@ -810,7 +816,8 @@ class GossipSub:
                 relay_mesh, st.nbrs, st.edge_live, st.alive, have_w,
                 st.fresh_w, valid_w,
                 interpret=jax.default_backend() != "tpu",
-                fresh_src=fresh_src,
+                fresh_src=fresh_src, idontwant=self.params.idontwant,
+                idw_have_w=idw,
             )
         elif self.use_pallas:
             from ..ops.pallas_gossip import propagate_packed_pallas
@@ -819,12 +826,14 @@ class GossipSub:
                 relay_mesh, st.nbrs, st.edge_live, st.alive, have_w,
                 st.fresh_w, valid_w,
                 interpret=jax.default_backend() != "tpu",
-                fresh_src=fresh_src,
+                fresh_src=fresh_src, idontwant=self.params.idontwant,
+                idw_have_w=idw,
             )
         else:
             out = gossip_ops.propagate_packed(
                 relay_mesh, st.nbrs, st.edge_live, st.alive, have_w,
                 st.fresh_w, valid_w, fresh_src=fresh_src,
+                idontwant=self.params.idontwant, idw_have_w=idw,
             )
         # One [N, M] stamping pass for both receipt sources (pend fold +
         # eager push): both record the same step, so the union stamps once.

@@ -63,6 +63,14 @@ def propagate(
     first_step: jax.Array,  # i32[N, M] step of first receipt, -1 = never
     msg_valid: jax.Array,   # bool[M] validation verdict per message
     step: jax.Array,        # i32 current step
+    idontwant: bool = False,  # v1.2: senders skip ids the receiver had last
+    #                           round (its IDONTWANT notifications); only
+    #                           duplicate-copy counting changes
+    idw_have=None,            # bool[N, M] the possession snapshot the
+    #                           notifications reflect (receiver's knowledge
+    #                           one hop ago); defaults to ``have`` — callers
+    #                           whose ``have`` already includes same-round
+    #                           fold receipts MUST pass the pre-fold view
 ) -> PropagateOut:
     """One eager-push round: every peer relays last round's first-receipts to
     its mesh neighbors; receivers validate, deduplicate, attribute delivery
@@ -95,7 +103,15 @@ def propagate(
     fmd_inc = (newly & msg_valid[None, None, :]).sum(axis=2).astype(jnp.float32)
     invalid_inc = (newly & ~msg_valid[None, None, :]).sum(axis=2).astype(jnp.float32)
     # Mesh-delivery counter counts first + duplicate copies from mesh links.
-    mmd_inc = (incoming & msg_valid[None, None, :]).sum(axis=2).astype(jnp.float32)
+    # Under IDONTWANT (v1.2) a sender skips ids the receiver first-received
+    # in an EARLIER round (the receiver's notification had a round to
+    # arrive); same-round duplicates still cross the wire, exactly as the
+    # wire races the notification.  Deliveries/receipts are unaffected —
+    # the receiver's dedup already ignored these copies; the suppression
+    # removes them from the wire and from P3 counting.
+    idw = have if idw_have is None else idw_have
+    counted = incoming if not idontwant else (incoming & ~idw[:, None, :])
+    mmd_inc = (counted & msg_valid[None, None, :]).sum(axis=2).astype(jnp.float32)
 
     have_next = have | (new & msg_valid[None, :])
     fresh_next = new & msg_valid[None, :]

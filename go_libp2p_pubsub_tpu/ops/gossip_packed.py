@@ -80,6 +80,10 @@ def propagate_packed(
     valid_w: jax.Array,    # u32[W]  packed (msg_valid & msg_active)
     fresh_src=None,        # u32[N, K, W] pre-gathered per-edge sender planes
                            # (per-edge delay mode); None -> fresh_w[nbrs]
+    idontwant: bool = False,  # v1.2 duplicate suppression (see gossip.propagate)
+    idw_have_w=None,       # u32[N, W] pre-fold possession snapshot the
+                           # IDONTWANT notifications reflect; defaults to
+                           # have_w (see gossip.propagate's idw_have)
 ) -> PropagatePackedOut:
     """One eager-push round over packed windows.
 
@@ -106,7 +110,9 @@ def propagate_packed(
     pc = lambda x: jax.lax.population_count(x).sum(axis=-1).astype(jnp.float32)
     fmd_inc = pc(newly & valid_w)
     invalid_inc = pc(newly & ~valid_w)
-    mmd_inc = pc(inc & valid_w)
+    idw = have_w if idw_have_w is None else idw_have_w
+    counted = inc if not idontwant else (inc & ~idw[:, None, :])
+    mmd_inc = pc(counted & valid_w)
 
     return PropagatePackedOut(
         have_w=have_w | (new_w & valid_w),

@@ -77,6 +77,8 @@ def _row_block(width):
 def _propagate_kernel(
     inc_ref,    # u32[T, K*W] gathered neighbor fresh words, edge-masked
     have_ref,   # u32[T, W]
+    idw_ref,    # u32[T, W]   pre-fold possession (IDONTWANT knowledge plane;
+                #             equal to have_ref when the flag is off)
     alive_ref,  # u32[T, 1]   alive mask
     valid_ref,  # u32[1, W]   packed (msg_valid & msg_active)
     gmat_ref,   # f32[K*W, K] slot group-sum matrix
@@ -86,6 +88,8 @@ def _propagate_kernel(
     fmd_o,      # f32[T, K]
     mmd_o,      # f32[T, K]
     inv_o,      # f32[T, K]
+    *,
+    idontwant: bool = False,
 ):
     t, w = have_ref.shape
     l = inc_ref.shape[1]
@@ -123,14 +127,21 @@ def _propagate_kernel(
     dot = functools.partial(jnp.dot, preferred_element_type=jnp.float32)
     fmd_o[:] = dot(pc(newly & valid_l), g)
     inv_o[:] = dot(pc(newly & ~valid_l), g)
-    mmd_o[:] = dot(pc(inc & valid_l), g)
+    # v1.2 IDONTWANT: copies of ids the receiver already had (its prior-round
+    # notification reached the sender) never cross the wire, so they leave
+    # P3 mesh-delivery counting (see gossip.propagate).
+    counted = (
+        inc if not idontwant
+        else (inc & ~pltpu.repeat(idw_ref[:], k, axis=1))
+    )
+    mmd_o[:] = dot(pc(counted & valid_l), g)
 
     have_o[:] = have | (new & valid)
     fresh_o[:] = new & valid
     new_o[:] = new
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "idontwant"))
 def propagate_packed_pallas(
     mesh: jax.Array,       # bool[N, K]
     nbrs: jax.Array,       # i32[N, K]
@@ -142,6 +153,9 @@ def propagate_packed_pallas(
     interpret: bool = False,
     fresh_src=None,        # u32[N, K, W] pre-gathered per-edge sender planes
                            # (per-edge delay mode); None -> fresh_w[nbrs]
+    idontwant: bool = False,
+    idw_have_w=None,       # u32[N, W] pre-fold possession snapshot (see
+                           # gossip.propagate's idw_have); None -> have_w
 ) -> PropagatePackedOut:
     """Drop-in replacement for ``gossip_packed.propagate_packed`` backed by
     the fused Pallas kernel.  ``interpret=True`` runs the kernel in the
@@ -157,17 +171,20 @@ def propagate_packed_pallas(
     src = fresh_w[j] if fresh_src is None else fresh_src
     inc = jnp.where(edge_ok[:, :, None], src, jnp.uint32(0)).reshape(n, l)
     alive_m = _as_mask(alive)[:, None]
+    idw_in = have_w if idw_have_w is None else idw_have_w
 
-    n_pad, (inc, have_in, alive_m) = _pad_rows(n, inc, have_w, alive_m)
+    n_pad, (inc, have_in, idw_in, alive_m) = _pad_rows(
+        n, inc, have_w, idw_in, alive_m
+    )
 
     full = lambda shape: pl.BlockSpec(
         shape, lambda i: (0, 0), memory_space=pltpu.VMEM
     )
     outs = pl.pallas_call(
-        _propagate_kernel,
+        functools.partial(_propagate_kernel, idontwant=idontwant),
         grid=(n_pad // TILE,),
         in_specs=[
-            _row_block(l), _row_block(w), _row_block(1),
+            _row_block(l), _row_block(w), _row_block(w), _row_block(1),
             full((1, w)), full((l, k)),
         ],
         out_specs=(
@@ -183,7 +200,7 @@ def propagate_packed_pallas(
             jax.ShapeDtypeStruct((n_pad, k), jnp.float32),
         ),
         interpret=interpret,
-    )(inc, have_in, alive_m, valid_w[None, :], _group_sum_matrix(l, k))
+    )(inc, have_in, idw_in, alive_m, valid_w[None, :], _group_sum_matrix(l, k))
 
     have_o, fresh_o, new_o, fmd, mmd, inv = (x[:n] for x in outs)
     return PropagatePackedOut(
@@ -413,6 +430,8 @@ def propagate_packed_pallas_sharded(
     interpret: bool = False,
     fresh_src=None,        # u32[N, K, W] pre-gathered sender planes (delay mode)
     axis: str = "peers",
+    idontwant: bool = False,
+    idw_have_w=None,       # u32[N, W] pre-fold possession snapshot
 ) -> PropagatePackedOut:
     """``shard_map`` form of the fused kernel for the GSPMD peer-sharded sim.
 
@@ -437,29 +456,33 @@ def propagate_packed_pallas_sharded(
     rows = P(axis, None)
     out_specs = PropagatePackedOut(rows, rows, rows, rows, rows, rows)
 
+    idw = have_w if idw_have_w is None else idw_have_w
     if fresh_src is None:
-        def local(mesh_l, nbrs_l, el_l, alive_l, have_l, fresh_l, valid_l):
+        def local(mesh_l, nbrs_l, el_l, alive_l, have_l, fresh_l, valid_l,
+                  idw_l):
             fresh_full = jax.lax.all_gather(fresh_l, axis, tiled=True)
             src = fresh_full[jnp.clip(nbrs_l, 0, n - 1)]
             return propagate_packed_pallas(
                 mesh_l, nbrs_l, el_l, alive_l, have_l, fresh_l, valid_l,
-                interpret=interpret, fresh_src=src,
+                interpret=interpret, fresh_src=src, idontwant=idontwant,
+                idw_have_w=idw_l,
             )
 
-        in_specs = (rows, rows, rows, P(axis), rows, rows, P(None))
-        args = (mesh, nbrs, edge_live, alive, have_w, fresh_w, valid_w)
+        in_specs = (rows, rows, rows, P(axis), rows, rows, P(None), rows)
+        args = (mesh, nbrs, edge_live, alive, have_w, fresh_w, valid_w, idw)
     else:
         def local(mesh_l, nbrs_l, el_l, alive_l, have_l, fresh_l, valid_l,
-                  src_l):
+                  src_l, idw_l):
             return propagate_packed_pallas(
                 mesh_l, nbrs_l, el_l, alive_l, have_l, fresh_l, valid_l,
-                interpret=interpret, fresh_src=src_l,
+                interpret=interpret, fresh_src=src_l, idontwant=idontwant,
+                idw_have_w=idw_l,
             )
 
         in_specs = (rows, rows, rows, P(axis), rows, rows, P(None),
-                    P(axis, None, None))
+                    P(axis, None, None), rows)
         args = (mesh, nbrs, edge_live, alive, have_w, fresh_w, valid_w,
-                fresh_src)
+                fresh_src, idw)
 
     f = shard_map(
         local, mesh=device_mesh, in_specs=in_specs, out_specs=out_specs,

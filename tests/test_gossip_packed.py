@@ -363,3 +363,96 @@ def test_muted_advertiser_loses_grip_via_score_gate():
     assert late < 0.3 * early, (
         f"asks to muted peers never tapered: deltas {bp_deltas}"
     )
+
+
+@pytest.mark.parametrize("seed", [0, 2])
+def test_idontwant_packed_matches_reference_and_only_cuts_mmd(seed):
+    """gossipsub v1.2 IDONTWANT: packed and unpacked agree bit-for-bit with
+    the flag on, and vs the flag OFF only the duplicate-copy counting
+    (mmd_inc) changes — deliveries, receipts, and attribution are
+    untouched (the receiver's dedup already ignored those copies)."""
+    mesh, nbrs, rev, valid, alive, have, fresh, msg_valid = _random_state(seed)
+    n, m = have.shape
+    first_step = jnp.full((n, m), -1, jnp.int32)
+    step = jnp.int32(7)
+    edge_live = jnp.asarray(
+        np.asarray(valid)
+        & np.asarray(alive)[np.clip(np.asarray(nbrs), 0, n - 1)]
+    )
+    ref_on = ref_ops.propagate(
+        mesh, nbrs, valid, alive, have, fresh, first_step, msg_valid, step,
+        idontwant=True,
+    )
+    out_on = packed_ops.propagate_packed(
+        mesh, nbrs, edge_live, alive, bitpack.pack(have), bitpack.pack(fresh),
+        bitpack.pack(msg_valid), idontwant=True,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(bitpack.unpack(out_on.have_w, m)), np.asarray(ref_on.have)
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_on.mmd_inc), np.asarray(ref_on.mmd_inc)
+    )
+    out_off = packed_ops.propagate_packed(
+        mesh, nbrs, edge_live, alive, bitpack.pack(have), bitpack.pack(fresh),
+        bitpack.pack(msg_valid), idontwant=False,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out_on.have_w), np.asarray(out_off.have_w)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out_on.fresh_w), np.asarray(out_off.fresh_w)
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_on.fmd_inc), np.asarray(out_off.fmd_inc)
+    )
+    assert (np.asarray(out_on.mmd_inc) <= np.asarray(out_off.mmd_inc)).all()
+    # The dense fixture has real duplicates: suppression must actually bite.
+    assert np.asarray(out_on.mmd_inc).sum() < np.asarray(out_off.mmd_inc).sum()
+
+
+def test_idontwant_same_round_fold_receipts_still_counted():
+    """One-round-notification-delay semantics: a duplicate of a message the
+    receiver acquired THIS round (gossip/flood fold — pre-fold snapshot
+    lacks the bit) still crosses the wire and is counted; only ids known
+    since LAST round are suppressed."""
+    mesh, nbrs, rev, valid, alive, have, fresh, msg_valid = _random_state(5)
+    n, m = have.shape
+    edge_live = jnp.asarray(
+        np.asarray(valid)
+        & np.asarray(alive)[np.clip(np.asarray(nbrs), 0, n - 1)]
+    )
+    have_w = bitpack.pack(have)
+    # Pre-fold snapshot: drop a random subset of the possession bits (those
+    # "arrived this round via the fold").
+    rng = np.random.default_rng(5)
+    pre = np.asarray(have) & (rng.random((n, m)) < 0.5)
+    pre_w = bitpack.pack(jnp.asarray(pre))
+    kw = dict(idontwant=True)
+    out_pre = packed_ops.propagate_packed(
+        mesh, nbrs, edge_live, alive, have_w, bitpack.pack(fresh),
+        bitpack.pack(msg_valid), idw_have_w=pre_w, **kw,
+    )
+    out_folded = packed_ops.propagate_packed(
+        mesh, nbrs, edge_live, alive, have_w, bitpack.pack(fresh),
+        bitpack.pack(msg_valid), **kw,  # defaults idw to the folded view
+    )
+    # Suppressing on the folded view removes MORE copies than the honest
+    # pre-fold snapshot (fold receipts' duplicates must still count).
+    assert (
+        np.asarray(out_pre.mmd_inc).sum()
+        > np.asarray(out_folded.mmd_inc).sum()
+    )
+    # Receipts identical either way.
+    np.testing.assert_array_equal(
+        np.asarray(out_pre.have_w), np.asarray(out_folded.have_w)
+    )
+    # Unpacked mirror agrees bit-for-bit on the pre-fold snapshot.
+    ref = ref_ops.propagate(
+        mesh, nbrs, valid, alive, have, fresh,
+        jnp.full((n, m), -1, jnp.int32), msg_valid, jnp.int32(3),
+        idontwant=True, idw_have=jnp.asarray(pre),
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_pre.mmd_inc), np.asarray(ref.mmd_inc)
+    )

@@ -246,3 +246,47 @@ def test_outbound_swap_never_exceeds_degree():
             p, outbound=outbound,
         )
         assert int(np.asarray(new_mesh[0]).sum()) <= p.d
+
+
+def test_idontwant_model_cuts_duplicates_only():
+    """v1.2 IDONTWANT at the model level: a rollout with the flag on is
+    leaf-for-leaf identical to the flag-off run EXCEPT the P3
+    mesh-delivery counters, which shrink (suppressed duplicate copies) —
+    deliveries, latencies, meshes, and scores-from-other-components agree."""
+    import jax
+
+    from go_libp2p_pubsub_tpu.config import GossipSubParams
+
+    # mesh_message_deliveries_weight is 0 by default, so scores (and thus
+    # mesh/PRNG trajectories) cannot diverge; only the counter differs.
+    kw = dict(n_peers=96, n_slots=16, conn_degree=10, msg_window=32,
+              use_pallas=False)
+    ga = GossipSub(params=GossipSubParams(idontwant=False), **kw)
+    gb = GossipSub(params=GossipSubParams(idontwant=True), **kw)
+    sa, sb = ga.init(seed=4), gb.init(seed=4)
+    for s in range(6):
+        sa = ga.publish(sa, jnp.int32(s * 5), jnp.int32(s), jnp.asarray(True))
+        sb = gb.publish(sb, jnp.int32(s * 5), jnp.int32(s), jnp.asarray(True))
+    sa, sb = ga.run(sa, 20), gb.run(sb, 20)
+    mmd_a = np.asarray(sa.counters.mesh_message_deliveries)
+    mmd_b = np.asarray(sb.counters.mesh_message_deliveries)
+    assert mmd_b.sum() < mmd_a.sum(), "suppression never bit"
+    # Everything except the P3 counter is bit-identical.
+    fields = type(sa)._fields
+    for name in fields:
+        if name == "counters":
+            continue
+        for la, lb in zip(
+            jax.tree.leaves(getattr(sa, name)), jax.tree.leaves(getattr(sb, name))
+        ):
+            np.testing.assert_array_equal(
+                np.asarray(la), np.asarray(lb), err_msg=f"field {name} diverged"
+            )
+    ca, cb = sa.counters, sb.counters
+    for cname in type(ca)._fields:
+        if cname == "mesh_message_deliveries":
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ca, cname)), np.asarray(getattr(cb, cname)),
+            err_msg=f"counter {cname} diverged",
+        )

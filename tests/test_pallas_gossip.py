@@ -186,3 +186,24 @@ def test_model_rollout_pallas_path_matches_jnp_path():
     sa, sb = ga.run(sa, 18), gb.run(sb, 18)
     for la, lb in zip(jax.tree.leaves(sa), jax.tree.leaves(sb)):
         np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_pallas_idontwant_matches_jnp():
+    """The kernel's IDONTWANT duplicate suppression is bit-exact with the
+    jnp packed form, including a pre-fold knowledge plane distinct from
+    the folded possession view."""
+    args = _state(6, 200)
+    n = args[1].shape[0]
+    w = args[4].shape[1]
+    rng = np.random.default_rng(12)
+    idw = args[4] & jnp.asarray(
+        rng.integers(0, 2**32, (n, w), dtype=np.uint32)
+    )
+    ref = gossip_packed.propagate_packed(
+        *args, idontwant=True, idw_have_w=idw
+    )
+    out = propagate_packed_pallas(
+        *args, interpret=True, idontwant=True, idw_have_w=idw
+    )
+    for la, lb in zip(ref, out):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
