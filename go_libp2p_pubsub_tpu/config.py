@@ -135,7 +135,11 @@ class GossipSubParams:
     #                          suppression masks the duplicate copies that
     #                          would have crossed the wire (observable as
     #                          lower P3 mesh-delivery counting; deliveries,
-    #                          receipts, and all other state are unchanged)
+    #                          receipts, and all other state are unchanged).
+    #                          Inert under per-edge delay (max_edge_delay>0):
+    #                          a one-round snapshot cannot represent d-round
+    #                          notification paths, so the model
+    #                          conservatively counts those duplicates
 
     def __post_init__(self) -> None:
         if not (self.d_lo <= self.d <= self.d_hi):

@@ -806,8 +806,13 @@ class GossipSub:
         # (st.have_w): the notifications are one hop old, so a message that
         # folded in via IWANT/flood THIS round races the eager copy and its
         # duplicate still crosses the wire (gossip.propagate's documented
-        # one-round-delay semantics).
-        idw = st.have_w if self.params.idontwant else None
+        # one-round-delay semantics).  Under per-edge delay the notification
+        # itself would take edge_delay rounds to cross back, which the
+        # one-round snapshot cannot represent — suppression is conservatively
+        # DISABLED in that mode (duplicates count, never misattributed)
+        # rather than crediting senders with knowledge they could not have.
+        idontwant = self.params.idontwant and not self.max_edge_delay
+        idw = st.have_w if idontwant else None
         if self.use_pallas and self.pallas_shard_mesh is not None:
             from ..ops.pallas_gossip import propagate_packed_pallas_sharded
 
@@ -816,7 +821,7 @@ class GossipSub:
                 relay_mesh, st.nbrs, st.edge_live, st.alive, have_w,
                 st.fresh_w, valid_w,
                 interpret=jax.default_backend() != "tpu",
-                fresh_src=fresh_src, idontwant=self.params.idontwant,
+                fresh_src=fresh_src, idontwant=idontwant,
                 idw_have_w=idw,
             )
         elif self.use_pallas:
@@ -826,14 +831,14 @@ class GossipSub:
                 relay_mesh, st.nbrs, st.edge_live, st.alive, have_w,
                 st.fresh_w, valid_w,
                 interpret=jax.default_backend() != "tpu",
-                fresh_src=fresh_src, idontwant=self.params.idontwant,
+                fresh_src=fresh_src, idontwant=idontwant,
                 idw_have_w=idw,
             )
         else:
             out = gossip_ops.propagate_packed(
                 relay_mesh, st.nbrs, st.edge_live, st.alive, have_w,
                 st.fresh_w, valid_w, fresh_src=fresh_src,
-                idontwant=self.params.idontwant, idw_have_w=idw,
+                idontwant=idontwant, idw_have_w=idw,
             )
         # One [N, M] stamping pass for both receipt sources (pend fold +
         # eager push): both record the same step, so the union stamps once.

@@ -22,3 +22,19 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _fresh_jit_caches_per_module():
+    """The XLA CPU compiler has been observed to SEGFAULT (rc 139) on large
+    compilations after ~130 accumulated in-process tests — reproduced in
+    different modules on different runs (a vmapped multitopic heartbeat, an
+    interpret-mode pallas rollout), each of which passes standalone.
+    Dropping the jit caches at every module boundary keeps the compiler's
+    working set bounded for the full-suite run; the cost is re-compiling
+    shared helpers per module (~minutes over the whole suite)."""
+    jax.clear_caches()
+    yield

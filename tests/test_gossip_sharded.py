@@ -190,3 +190,42 @@ def test_sharded_multitopic_matches_unsharded_bitwise():
     assert sb.nbrs.sharding.spec[0] == PEER_AXIS
     for la, lb in zip(jax.tree.leaves(sa), jax.tree.leaves(sb)):
         np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_sharded_pallas_idontwant_matches_jnp():
+    """IDONTWANT through the shard_map propagate wrapper (the two-branch
+    arg/spec plumbing) must be bit-exact with the jnp packed form on a
+    distinct pre-fold knowledge plane."""
+    from go_libp2p_pubsub_tpu.models.gossipsub import build_topology
+    from go_libp2p_pubsub_tpu.ops import bitpack, gossip_packed
+    from go_libp2p_pubsub_tpu.ops.pallas_gossip import (
+        propagate_packed_pallas_sharded,
+    )
+    from go_libp2p_pubsub_tpu.parallel.mesh import make_mesh
+
+    n, k, m = 256, 16, 64
+    rng = np.random.default_rng(8)
+    nbrs, rev, valid, _ = build_topology(rng, n, k, 8)
+    mesh = valid & (rng.random((n, k)) < 0.6)
+    j = np.clip(nbrs, 0, n - 1)
+    mesh = mesh & mesh[j, np.clip(rev, 0, k - 1)]
+    alive = rng.random(n) < 0.9
+    have = rng.random((n, m)) < 0.3
+    fresh = have & (rng.random((n, m)) < 0.5)
+    msg_valid = rng.random(m) < 0.8
+    edge_live = valid & alive[j]
+    have_w = bitpack.pack(jnp.asarray(have))
+    idw = bitpack.pack(jnp.asarray(have & (rng.random((n, m)) < 0.5)))
+    args = (
+        jnp.asarray(mesh), jnp.asarray(nbrs, jnp.int32),
+        jnp.asarray(edge_live), jnp.asarray(alive), have_w,
+        bitpack.pack(jnp.asarray(fresh)),
+        bitpack.pack(jnp.asarray(msg_valid)),
+    )
+    ref = gossip_packed.propagate_packed(*args, idontwant=True, idw_have_w=idw)
+    out = propagate_packed_pallas_sharded(
+        make_mesh(N_DEV), *args, interpret=True, idontwant=True,
+        idw_have_w=idw,
+    )
+    for la, lb in zip(ref, out):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))

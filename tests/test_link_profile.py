@@ -384,3 +384,28 @@ def test_uniform_edge_delay_shifts_p50_not_delivery():
         f"delay must not lose messages: {frac0}, {frac1}"
     )
     assert p50_1 > p50_0, f"p50 must grow under delay: {p50_0} -> {p50_1}"
+
+
+def test_idontwant_inert_under_per_edge_delay():
+    """IDONTWANT + per-edge delay: the one-round knowledge snapshot cannot
+    represent a d-round notification path, so the model conservatively
+    disables suppression — the rollout is leaf-for-leaf identical to the
+    flag-off run (duplicates count; senders are never credited with
+    knowledge they could not have)."""
+    from go_libp2p_pubsub_tpu.config import GossipSubParams
+
+    kw = dict(n_peers=32, n_slots=8, conn_degree=4, msg_window=8,
+              use_pallas=False, max_edge_delay=2)
+    ga = GossipSub(params=GossipSubParams(idontwant=False), **kw)
+    gb = GossipSub(params=GossipSubParams(idontwant=True), **kw)
+    sa, sb = ga.init(seed=1), gb.init(seed=1)
+    delay = np.ones((32, 8), np.int32)
+    sa, sb = ga.set_edge_delay(sa, delay), gb.set_edge_delay(sb, delay)
+    for s in range(4):
+        sa = ga.publish(sa, jnp.int32(s), jnp.int32(s), jnp.asarray(True))
+        sb = gb.publish(sb, jnp.int32(s), jnp.int32(s), jnp.asarray(True))
+    sa, sb = ga.run(sa, 20), gb.run(sb, 20)
+    import jax
+
+    for la, lb in zip(jax.tree.leaves(sa), jax.tree.leaves(sb)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))

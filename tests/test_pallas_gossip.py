@@ -18,17 +18,6 @@ from go_libp2p_pubsub_tpu.ops import gossip_packed
 from go_libp2p_pubsub_tpu.ops.pallas_gossip import TILE, propagate_packed_pallas
 
 
-@pytest.fixture(scope="module", autouse=True)
-def _fresh_compile_caches():
-    """Interpret-mode kernels inside scan/cond inline enormous HLO; after
-    ~130 prior in-process tests the XLA CPU compiler has been observed to
-    SEGFAULT compiling the model-level tests here (compile-state pressure —
-    each passes standalone).  Dropping the accumulated jit caches before
-    this module keeps the full-suite run inside the compiler's envelope."""
-    jax.clear_caches()
-    yield
-
-
 def _state(seed, n, k=32, m=128, degree=12):
     rng = np.random.default_rng(seed)
     nbrs, rev, valid, _ = build_topology(rng, n, k, degree)
