@@ -258,6 +258,7 @@ class GossipSub:
         graft_spammers: Optional[np.ndarray] = None,
         max_edge_delay: int = 0,
         pallas_shard_mesh=None,
+        direct_edges: Optional[np.ndarray] = None,
     ):
         self.n = n_peers
         self.k = n_slots
@@ -281,6 +282,23 @@ class GossipSub:
         self.graft_spammers = (
             None if graft_spammers is None else jnp.asarray(graft_spammers)
         )
+        # Direct (explicit) peering, go-gossipsub's WithDirectPeers: a
+        # constructor-bound symmetric bool[N, K] slot mask of operator-
+        # configured always-forward edges.  Direct edges relay every round
+        # regardless of mesh membership or the remote's score (their RPCs
+        # bypass the graylist gate, as in go), and they are EXCLUDED from
+        # mesh maintenance — never grafted, pruned, or backoff-tracked.
+        # Model simplification (documented deviation): copies arriving over
+        # direct edges still feed the per-slot delivery counters.
+        if direct_edges is None:
+            self.direct_edges = None
+        else:
+            de = np.asarray(direct_edges, bool)
+            if de.shape != (n_peers, n_slots):
+                raise ValueError(
+                    f"direct_edges must be [N={n_peers}, K={n_slots}]"
+                )
+            self.direct_edges = jnp.asarray(de)
         # Pallas fast path.  A bare pallas_call does not partition under
         # GSPMD, so the sharded runner historically forced use_pallas=False;
         # passing ``pallas_shard_mesh`` (a jax.sharding.Mesh with a "peers"
@@ -325,6 +343,19 @@ class GossipSub:
         via fanout/flood)."""
         nbrs, rev, valid, outbound = self.build_graph(seed)
         n, k, m, w = self.n, self.k, self.m, self.w
+        if self.direct_edges is not None:
+            # Direct peering is mutual (both operators configure it): the
+            # mask must sit on wired slots and be symmetric over the pairing.
+            de = np.asarray(self.direct_edges)
+            nv = np.asarray(valid)
+            if (de & ~nv).any():
+                raise ValueError("direct_edges marks an unwired slot")
+            jn = np.clip(np.asarray(nbrs), 0, n - 1)
+            rv = np.clip(np.asarray(rev), 0, k - 1)
+            if (de != (de[jn, rv] & nv)).any():
+                raise ValueError(
+                    "direct_edges must be symmetric over the slot pairing"
+                )
         alive0 = jnp.ones((n,), bool)
         sub0 = (
             jnp.ones((n,), bool) if subscribed is None else jnp.asarray(subscribed)
@@ -424,6 +455,10 @@ class GossipSub:
             & st.nbr_sub[src]
             & (scores_src >= sp.publish_threshold)
         )
+        # Direct peers are covered by the unconditional always-forward path;
+        # go's Publish never selects them into flood/fanout targets.
+        if self.direct_edges is not None:
+            eligible = eligible & ~self.direct_edges[src]
         fanout, fanout_age = st.fanout, st.fanout_age
         if p.flood_publish:
             targets = eligible
@@ -635,6 +670,11 @@ class GossipSub:
         # kernels already symmetrize over).
         part = st.alive & st.subscribed
         edge_ok = st.edge_live & st.nbr_sub
+        # Direct edges never join the mesh (go keeps explicit peers outside
+        # mesh maintenance entirely) and carry no IHAVE/IWANT traffic —
+        # their eager always-forward path covers them.
+        if self.direct_edges is not None:
+            edge_ok = edge_ok & ~self.direct_edges
         hb_idx = st.step // self.heartbeat_steps
         do_og = (hb_idx % p.opportunistic_graft_ticks) == 0
 
@@ -697,9 +737,12 @@ class GossipSub:
         # priority order (one [N,K,W] gather; bit-exact with the unfused
         # advertise+select pair, which stays as the tested reference).
         serve_ok = ~safe_gather(st.gossip_mute, px.nbrs, True)
+        gossip_edges = edge_live & nbr_sub
+        if self.direct_edges is not None:
+            gossip_edges = gossip_edges & ~self.direct_edges
         exchange_args = (
             kgossip, kiwant, st.have_w, have_w, new_mesh, px.nbrs, px.rev,
-            edge_live & nbr_sub, part, scores, gossip_w, p,
+            gossip_edges, part, scores, gossip_w, p,
             sp.gossip_threshold, serve_ok, p.max_iwant_length,
         )
         if self.use_pallas:
@@ -725,10 +768,15 @@ class GossipSub:
         )[: self.n]
         g = g._replace(behaviour_penalty=g.behaviour_penalty + promise_viol)
 
-        # Fanout maintenance for non-subscribed publishers.
+        # Fanout maintenance for non-subscribed publishers (direct edges
+        # excluded: the always-forward path covers them, so they never
+        # occupy one of the D fanout slots — go's getPeers filter).
+        fanout_edges = edge_live & nbr_sub
+        if self.direct_edges is not None:
+            fanout_edges = fanout_edges & ~self.direct_edges
         fanout, age = self.fanout_maintenance(
             kfan, st.fanout, st.fanout_age, st.subscribed, st.alive,
-            edge_live & nbr_sub, scores,
+            fanout_edges, scores,
         )
 
         # Expire messages out of the mcache history window.  (iwant_pend_w
@@ -788,6 +836,15 @@ class GossipSub:
         relay_mesh = st.mesh & (
             st.scores >= self.score_params.graylist_threshold
         )
+        # Direct edges always relay (graylist bypass, mesh-independent);
+        # edge_live in the kernel still masks dead remotes.  The gate is the
+        # RECEIVER's own subscription (relay_mesh is receiver-indexed — the
+        # kernel pulls fresh_w[nbrs[i,s]] into i): go sends to every direct
+        # peer in the topic regardless of the sender's own membership.
+        if self.direct_edges is not None:
+            relay_mesh = relay_mesh | (
+                self.direct_edges & st.subscribed[:, None]
+            )
         valid_w = bitpack.pack(st.msg_valid & st.msg_active)
         # Per-edge delay mode: each edge reads its sender's fresh plane from
         # edge_delay[i, s] rounds back (plane (step-1-d) mod D of the rolling

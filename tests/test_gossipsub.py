@@ -290,3 +290,101 @@ def test_idontwant_model_cuts_duplicates_only():
             np.asarray(getattr(ca, cname)), np.asarray(getattr(cb, cname)),
             err_msg=f"counter {cname} diverged",
         )
+
+
+def test_direct_peering_always_forwards_and_stays_out_of_mesh():
+    """go-gossipsub WithDirectPeers analog: a direct edge relays every
+    round even when the remote's score is below the graylist threshold
+    (RPC gate bypass), and direct edges are never grafted into the mesh."""
+    from go_libp2p_pubsub_tpu.models.gossipsub import build_topology
+
+    n, k = 32, 8
+    # Pin the topology so we can mark one specific edge direct.
+    rng = np.random.default_rng(3)
+    nbrs, rev, valid, outbound = build_topology(rng, n, k, 4)
+    # Pick peer 0's first valid slot; its remote is `friend`.
+    s0 = int(np.nonzero(valid[0])[0][0])
+    friend, r0 = int(nbrs[0, s0]), int(rev[0, s0])
+    direct = np.zeros((n, k), bool)
+    direct[0, s0] = True
+    direct[friend, r0] = True
+
+    def pinned_builder(_rng, _n, _k, _deg):
+        return nbrs, rev, valid, outbound
+
+    gs = GossipSub(n_peers=n, n_slots=k, conn_degree=4, msg_window=8,
+                   use_pallas=False, builder=pinned_builder,
+                   direct_edges=direct)
+    st = gs.init(seed=0)
+    # Nuke peer 0's standing in everyone's view: app score far below the
+    # graylist threshold, so NO scored path would relay its frames.
+    app = jnp.zeros((n,), jnp.float32).at[0].set(-1e6)
+    st = st._replace(gcounters=st.gcounters._replace(app_score=app))
+    st = gs.run(st, gs.heartbeat_steps)  # scores/mesh react
+    assert not bool(np.asarray(st.mesh)[0].any()), "graylisted peer meshed"
+    st = gs.publish(st, jnp.int32(0), jnp.int32(0), jnp.asarray(True))
+    st = gs.run(st, 2)
+    fs = np.asarray(st.first_step)
+    assert fs[friend, 0] >= 0, "direct edge must forward past the graylist"
+    # Direct edges never in the mesh on either side.
+    mesh = np.asarray(st.mesh)
+    assert not mesh[0, s0] and not mesh[friend, r0]
+
+
+def test_direct_edges_validation():
+    """Asymmetric or unwired direct masks are rejected at init."""
+    from go_libp2p_pubsub_tpu.models.gossipsub import build_topology
+
+    n, k = 16, 8
+    rng = np.random.default_rng(1)
+    nbrs, rev, valid, outbound = build_topology(rng, n, k, 4)
+
+    def pinned_builder(_rng, _n, _k, _deg):
+        return nbrs, rev, valid, outbound
+
+    bad = np.zeros((n, k), bool)
+    s0 = int(np.nonzero(valid[0])[0][0])
+    bad[0, s0] = True  # one-sided
+    gs = GossipSub(n_peers=n, n_slots=k, conn_degree=4, msg_window=8,
+                   use_pallas=False, builder=pinned_builder, direct_edges=bad)
+    with pytest.raises(ValueError, match="symmetric"):
+        gs.init(seed=0)
+    unwired = np.zeros((n, k), bool)
+    free = int(np.nonzero(~valid[0])[0][0])
+    unwired[0, free] = True
+    gs2 = GossipSub(n_peers=n, n_slots=k, conn_degree=4, msg_window=8,
+                    use_pallas=False, builder=pinned_builder,
+                    direct_edges=unwired)
+    with pytest.raises(ValueError, match="unwired"):
+        gs2.init(seed=0)
+
+
+def test_direct_edge_respects_receiver_subscription():
+    """go only sends to direct peers in the topic: an UNsubscribed direct
+    peer must not receive topic traffic over its direct edge."""
+    from go_libp2p_pubsub_tpu.models.gossipsub import build_topology
+
+    n, k = 32, 8
+    rng = np.random.default_rng(3)
+    nbrs, rev, valid, outbound = build_topology(rng, n, k, 4)
+    s0 = int(np.nonzero(valid[0])[0][0])
+    friend, r0 = int(nbrs[0, s0]), int(rev[0, s0])
+    direct = np.zeros((n, k), bool)
+    direct[0, s0] = True
+    direct[friend, r0] = True
+
+    def pinned_builder(_rng, _n, _k, _deg):
+        return nbrs, rev, valid, outbound
+
+    gs = GossipSub(n_peers=n, n_slots=k, conn_degree=4, msg_window=8,
+                   use_pallas=False, builder=pinned_builder,
+                   direct_edges=direct)
+    st = gs.init(seed=0)
+    sub = np.ones(n, bool)
+    sub[friend] = False
+    st = gs.set_subscribed(st, jnp.asarray(sub))
+    st = gs.publish(st, jnp.int32(0), jnp.int32(0), jnp.asarray(True))
+    st = gs.run(st, 8)
+    assert int(np.asarray(st.first_step)[friend, 0]) < 0, (
+        "unsubscribed direct peer must not receive topic traffic"
+    )
