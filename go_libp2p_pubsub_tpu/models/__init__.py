@@ -1,1 +1,3 @@
-"""Protocol models: treecast (v0 parity flagship), floodsub, gossipsub."""
+"""Protocol models: treecast (v0 parity flagship), floodsub, randomsub,
+gossipsub, multitopic, attacks — the three upstream router families plus
+the v0 tree."""

@@ -1,0 +1,126 @@
+"""RandomSub — gossip-by-sampling, the third upstream router family.
+
+go-libp2p-pubsub ships three routers (FloodSub, RandomSub, GossipSub);
+RandomSub forwards each message to a RANDOM sample of connected topic peers
+instead of all of them (FloodSub) or a maintained mesh (GossipSub).  The
+upstream sample size is ``max(D, sqrt(topic size))`` per emission.  The v0
+reference has none of this (SURVEY.md §0); the model completes the router
+family the way FloodSub/GossipSub do — same adjacency form, array-native.
+
+Array formulation: each round, every peer draws a fresh keyed sample of
+``emit`` connection slots (``top_mask`` over uniform noise, the same device
+pattern as the gossip emission mask) and relays last round's receipts over
+exactly those edges.  The choice is formulated TARGET-SIDE through the
+reverse index (``chosen[nbrs[i,s], rev[i,s]]``) so the hot loop is a gather,
+which partitions under GSPMD like the GossipSub kernels.
+
+Probabilistic delivery: with sample size ~sqrt(N) the epidemic still
+completes with high probability but with a longer tail than flooding —
+exactly the upstream trade (bandwidth vs latency), pinned by the tests.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.graphs import safe_gather, top_mask
+from .floodsub import FloodSub
+from .gossipsub import build_topology
+
+
+class RandomSubState(NamedTuple):
+    nbrs: jax.Array        # i32[N, K]
+    rev: jax.Array         # i32[N, K]
+    nbr_valid: jax.Array   # bool[N, K]
+    alive: jax.Array       # bool[N]
+    have: jax.Array        # bool[N, M]
+    fresh: jax.Array       # bool[N, M]
+    first_step: jax.Array  # i32[N, M]
+    msg_valid: jax.Array   # bool[M]
+    msg_birth: jax.Array   # i32[M]
+    msg_used: jax.Array    # bool[M]
+    key: jax.Array         # PRNG key (per-round sample draws)
+    step: jax.Array
+
+
+class RandomSub(FloodSub):
+    """RandomSub router: per-round random-sample relay.
+
+    Subclasses :class:`FloodSub` and inherits its ``publish``, ``run``, and
+    ``delivery_stats`` verbatim (same slot-recycle and stats-masking rules,
+    one definition); only the construction (rev + PRNG state) and the relay
+    step (sampled instead of dense) differ.
+
+    ``d`` is the upstream ``RandomSubD`` floor; the per-round emission is
+    ``max(d, ceil(sqrt(n_peers)))`` capped by the slot count — the upstream
+    ``max(D, sqrt(topic size))`` rule with the topic assumed network-wide
+    (subscription masking composes the same way as FloodSub's liveness).
+    """
+
+    def __init__(self, n_peers: int = 1024, n_slots: int = 32,
+                 conn_degree: int = 16, msg_window: int = 128,
+                 d: int = 6, emit: Optional[int] = None):
+        self.n, self.k, self.m = n_peers, n_slots, msg_window
+        self.conn_degree = conn_degree
+        self.emit = (
+            min(max(d, math.isqrt(n_peers - 1) + 1), n_slots)
+            if emit is None else min(emit, n_slots)
+        )
+
+    def init(self, seed: int = 0) -> RandomSubState:
+        rng = np.random.default_rng(seed)
+        nbrs, rev, valid, _ = build_topology(
+            rng, self.n, self.k, self.conn_degree
+        )
+        n, m = self.n, self.m
+        return RandomSubState(
+            nbrs=jnp.asarray(nbrs, jnp.int32),
+            rev=jnp.asarray(rev, jnp.int32),
+            nbr_valid=jnp.asarray(valid),
+            alive=jnp.ones((n,), bool),
+            have=jnp.zeros((n, m), bool),
+            fresh=jnp.zeros((n, m), bool),
+            first_step=jnp.full((n, m), -1, jnp.int32),
+            msg_valid=jnp.zeros((m,), bool),
+            msg_birth=jnp.zeros((m,), jnp.int32),
+            msg_used=jnp.zeros((m,), bool),
+            key=jax.random.PRNGKey(seed),
+            step=jnp.asarray(0, jnp.int32),
+        )
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def kill_peers(self, st: RandomSubState, mask) -> RandomSubState:
+        return st._replace(alive=st.alive & ~mask)
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def step(self, st: RandomSubState) -> RandomSubState:
+        """One round: every peer relays last round's receipts to a FRESH
+        random sample of ``emit`` live connections (upstream RandomSub
+        re-samples per emission; here per round)."""
+        n, k = self.n, self.k
+        kdraw, knext = jax.random.split(st.key)
+        edge_live = st.nbr_valid & safe_gather(st.alive, st.nbrs, False)
+        r = jax.random.uniform(kdraw, (n, k))
+        chosen = top_mask(jnp.where(edge_live, r, -jnp.inf), self.emit)
+        # Target-side pull: neighbor j = nbrs[i,s] sampled me iff
+        # chosen[j, rev[i,s]] (the GSPMD-friendly reverse-index gather).
+        jidx = jnp.clip(st.nbrs, 0, n - 1)
+        ridx = jnp.clip(st.rev, 0, k - 1)
+        towards_me = chosen[jidx, ridx] & edge_live
+        arrived = (towards_me[:, :, None] & st.fresh[jidx]).any(axis=1)
+        new = arrived & ~st.have & st.alive[:, None]
+        return st._replace(
+            have=st.have | (new & st.msg_valid[None, :]),
+            fresh=new & st.msg_valid[None, :],
+            first_step=jnp.where(
+                new & (st.first_step < 0), st.step, st.first_step
+            ),
+            key=knext,
+            step=st.step + 1,
+        )
