@@ -14,9 +14,18 @@ curve (``ed25519_device_scaling``).
 
 Also measured and emitted as extra fields on the same JSON line:
 
+- ``flight``: the in-scan flight record — the measured rollout runs with
+  ``record=True``, so per-round delivery fraction, mesh-degree stats, score
+  quantiles and gossip backlog come back as [n_steps] series, plus the
+  device-side propagation-latency histogram with histogram-derived p50/p99
+  (one host sync at rollout end; ``utils.metrics.flight_summary``);
+- ``methodology_version``: accounting version for cross-round comparisons
+  (``tools/perf_diff.py`` refuses to diff mismatched versions silently);
 - ``phase_breakdown_ms``: where a rollout round's time goes — propagate vs
   heartbeat, and inside the heartbeat scores / mesh / PX / IHAVE+IWANT /
-  fanout (the ``tools/profile_rollout.py`` machinery, recorded per round);
+  fanout (the ``tools/profile_rollout.py`` machinery, recorded per round
+  through a ``StepTimer`` whose timeline exports as Chrome-trace JSON when
+  ``BENCH_TRACE_OUT`` names a path);
 - ``init_s`` / ``compile_s``: startup budgets (state init, rollout compile);
 - config (c): standalone batched ed25519 verify throughput, native C++
   (threaded) and TPU device kernel backends;
@@ -103,14 +112,19 @@ def probe_backend(timeout_s: float = PROBE_TIMEOUT_S) -> bool:
 
 
 def _parse_json_line(out: str):
-    """Last stdout line that parses as a JSON object, or None."""
+    """Last stdout line that parses as a JSON object, or None.
+
+    A ``{``-prefixed line that fails to parse (truncated tail from a killed
+    child, an interleaved log fragment) must not end the scan: keep walking
+    back — an earlier intact JSON line still salvages the run.
+    """
     for line in reversed(out.strip().splitlines()):
         line = line.strip()
         if line.startswith("{"):
             try:
                 return json.loads(line)
             except json.JSONDecodeError:
-                return None
+                continue
     return None
 
 
@@ -281,11 +295,16 @@ def bench_treecast(n_msgs=64, n_peers=10):
     return delivered / dt, steps / dt
 
 
-def phase_breakdown(gs, st, reps):
+def phase_breakdown(gs, st, reps, timer=None):
     """Per-phase times (ms) of one rollout round at the bench scale: the
     ``tools/profile_rollout.py`` machinery recorded into the bench JSON (r4
     verdict item 1).  Sub-phases re-run the heartbeat's own kernels on the
-    same state the heartbeat sees."""
+    same state the heartbeat sees.
+
+    Phases record through a :class:`StepTimer` (pass one to share the
+    timeline with the caller's own phases), so the whole bench exports as a
+    Chrome-trace flame track (``BENCH_TRACE_OUT``) instead of a flat dict.
+    """
     import jax
 
     from go_libp2p_pubsub_tpu.ops import gossip_packed as gossip_ops
@@ -293,21 +312,22 @@ def phase_breakdown(gs, st, reps):
     from go_libp2p_pubsub_tpu.ops.gossip import heartbeat_mesh
     from go_libp2p_pubsub_tpu.ops.graphs import safe_gather
     from go_libp2p_pubsub_tpu.ops.px import px_rewire
+    from go_libp2p_pubsub_tpu.utils.trace import StepTimer
 
     p, sp = gs.params, gs.score_params
-    out = {}
+    timer = timer if timer is not None else StepTimer()
+    phase_names = []
 
     def timeit(name, fn, *args):
         # Arrays MUST ride as jit ARGUMENTS: a closure over device arrays
         # turns them into compile-time constants and XLA constant-folds the
         # whole phase away (measuring a cached literal, not the kernel).
         f = jax.jit(fn)
-        o = jax.block_until_ready(f(*args))  # compile
-        t0 = time.perf_counter()
+        jax.block_until_ready(f(*args))  # compile
         for _ in range(reps):
-            o = f(*args)
-        jax.block_until_ready(o)
-        out[name] = round((time.perf_counter() - t0) / reps * 1e3, 2)
+            with timer(name):
+                timer.fence(f(*args))
+        phase_names.append(name)
 
     # gs.step's heartbeat rides a lax.cond keyed on st.step % heartbeat_steps,
     # so timing step() at one fixed st measures ONE branch; the honest
@@ -319,13 +339,9 @@ def phase_breakdown(gs, st, reps):
 
     f = jax.jit(full_cycle)
     jax.block_until_ready(f(st))
-    t0 = time.perf_counter()
     for _ in range(max(1, reps // 2)):
-        o = f(st)
-    jax.block_until_ready(o)
-    out["round_amortized"] = round(
-        (time.perf_counter() - t0) / max(1, reps // 2) / hb_steps * 1e3, 2
-    )
+        with timer("round_cycle"):
+            timer.fence(f(st))
     timeit("propagate", gs._propagate, st)
     timeit("heartbeat", gs._heartbeat, st)
 
@@ -379,6 +395,10 @@ def phase_breakdown(gs, st, reps):
 
     timeit("hb_fanout", gs.fanout_maintenance, key, st.fanout,
            st.fanout_age, st.subscribed, st.alive, edge_ok, scores)
+
+    stats = timer.stats()
+    out = {n: round(stats[n]["mean_ms"], 2) for n in phase_names}
+    out["round_amortized"] = round(stats["round_cycle"]["mean_ms"] / hb_steps, 2)
     return out
 
 
@@ -395,12 +415,20 @@ def child_main() -> None:
         jax.config.update("jax_platforms", "cpu")
 
     from go_libp2p_pubsub_tpu.models.gossipsub import GossipSub
+    from go_libp2p_pubsub_tpu.utils.metrics import (
+        MetricsRegistry, flight_summary, gossip_metrics)
+    from go_libp2p_pubsub_tpu.utils.trace import StepTimer
 
     n_peers = scale["n_peers"]
     dev = jax.devices()[0]
     backend_note = "default" if mode == "tpu" else "cpu-fallback (TPU unavailable)"
     log(f"bench device: {dev.device_kind}  mode={mode}  n_peers={n_peers}")
     rng = np.random.default_rng(1)
+    # One timer + registry across the whole child: the phase timeline is
+    # Chrome-trace exportable (BENCH_TRACE_OUT) and the headline lands in the
+    # same MetricsRegistry the live plane's /metrics endpoint serves.
+    timer = StepTimer()
+    registry = MetricsRegistry()
 
     # -- signed message window, verified on BOTH backends -------------------
     t0 = time.perf_counter()
@@ -444,10 +472,9 @@ def child_main() -> None:
         conn_degree=scale["degree"],
         msg_window=N_MSGS,
     )
-    t0 = time.perf_counter()
-    st = gs.init(seed=0)
-    jax.block_until_ready(st.mesh)
-    init_s = time.perf_counter() - t0
+    with timer("init"):
+        st = timer.fence(gs.init(seed=0))
+    init_s = timer.samples["init"][-1]
     log(f"init ({n_peers} peers): {init_s:.1f}s")
 
     for slot in range(N_MSGS):
@@ -459,38 +486,38 @@ def child_main() -> None:
         )
     jax.block_until_ready(st.have_w)
 
-    rollout = lambda s: gs.run(s, ROLLOUT_STEPS)
-    t0 = time.perf_counter()
-    try:
-        warm = rollout(st)  # compile
-        jax.block_until_ready(warm.have_w)
-    except Exception as e:  # noqa: BLE001 — any Mosaic/compile failure
-        # The Pallas kernels are equivalence-tested in interpret mode but a
-        # Mosaic lowering regression on the real chip must cost us the fast
-        # kernel, not the whole on-chip number: retry the rollout on the
-        # portable jnp kernels (the state is kernel-independent).
-        if not gs.use_pallas:
-            raise
-        log(f"pallas rollout failed to compile ({type(e).__name__}: "
-            f"{str(e)[:200]}); retrying with jnp kernels")
-        gs = GossipSub(
-            n_peers=n_peers, n_slots=scale["n_slots"],
-            conn_degree=scale["degree"], msg_window=N_MSGS,
-            use_pallas=False,
-        )
-        rollout = lambda s: gs.run(s, ROLLOUT_STEPS)
-        warm = rollout(st)
-        jax.block_until_ready(warm.have_w)
-    compile_s = time.perf_counter() - t0
+    # The flight recorder rides the measured rollout (record=True): the
+    # headline is charged the in-scan telemetry it ships with.
+    rollout = lambda s: gs.rollout(s, ROLLOUT_STEPS, record=True)
+    with timer("compile"):
+        try:
+            timer.fence(rollout(st))  # compile
+        except Exception as e:  # noqa: BLE001 — any Mosaic/compile failure
+            # The Pallas kernels are equivalence-tested in interpret mode but
+            # a Mosaic lowering regression on the real chip must cost us the
+            # fast kernel, not the whole on-chip number: retry the rollout on
+            # the portable jnp kernels (the state is kernel-independent).
+            if not gs.use_pallas:
+                raise
+            log(f"pallas rollout failed to compile ({type(e).__name__}: "
+                f"{str(e)[:200]}); retrying with jnp kernels")
+            gs = GossipSub(
+                n_peers=n_peers, n_slots=scale["n_slots"],
+                conn_degree=scale["degree"], msg_window=N_MSGS,
+                use_pallas=False,
+            )
+            rollout = lambda s: gs.rollout(s, ROLLOUT_STEPS, record=True)
+            timer.fence(rollout(st))
+    compile_s = timer.samples["compile"][-1]
     log(f"compile+warm rollout: {compile_s:.1f}s")
 
-    t0 = time.perf_counter()
-    out = rollout(st)
-    jax.block_until_ready(out.have_w)
-    rollout_dt = time.perf_counter() - t0
+    with timer("rollout"):
+        out, flight_rec = timer.fence(rollout(st))
+    rollout_dt = timer.samples["rollout"][-1]
+    flight = flight_summary(flight_rec)  # ONE host sync for all series
 
     # -- per-phase breakdown + standalone heartbeat -------------------------
-    phases = phase_breakdown(gs, out, scale["reps"])
+    phases = phase_breakdown(gs, out, scale["reps"], timer=timer)
     scoring_ms = phases["heartbeat"]
     log(f"phase breakdown (ms): {phases}")
 
@@ -507,6 +534,20 @@ def child_main() -> None:
     total_dt = rollout_dt + verify_dt
     value = delivered / total_dt
 
+    # The headline lands in the registry (what a scrape of the bench process
+    # would see) and the stderr log shows the exposition for the record.
+    registry.inc("bench.rollouts")
+    registry.gauge("bench.msgs_per_sec", value)
+    registry.gauge("bench.p50_latency_rounds", float(p50))
+    registry.observe_state("gossip", gossip_metrics(out))
+    log("prometheus exposition:\n" + registry.render_prometheus())
+
+    trace_out = os.environ.get("BENCH_TRACE_OUT")
+    if trace_out:
+        with open(trace_out, "w") as fh:
+            fh.write(timer.export_chrome_trace())
+        log(f"chrome trace ({len(timer.events)} events) -> {trace_out}")
+
     log(
         f"{delivered:.0f} validated deliveries in {total_dt*1e3:.0f} ms "
         f"(rollout {rollout_dt*1e3:.0f} + verify {verify_dt*1e3:.1f}; "
@@ -519,6 +560,10 @@ def child_main() -> None:
                 "metric": "gossipsub_100k_validated_msgs_per_sec",
                 "value": round(value, 1),
                 "unit": "msgs/sec",
+                # Accounting version for cross-round diffs (tools/perf_diff.py):
+                # v2 = charged-window-share verify accounting (r5+);
+                # v1 = full device-batch verify charged (r3).  See PERF.md.
+                "methodology_version": 2,
                 "vs_baseline": round(value / BASELINE_MSGS_PER_SEC, 4),
                 "p50_latency_rounds": float(p50),
                 "delivery_frac": round(mean_frac, 6),
@@ -533,6 +578,7 @@ def child_main() -> None:
                 "init_s": round(init_s, 1),
                 "compile_s": round(compile_s, 1),
                 "phase_breakdown_ms": phases,
+                "flight": flight,
                 "ed25519_device_scaling": device_curve,
                 "ed25519_native_sigs_per_sec": round(native_sigs_per_sec, 1),
                 "treecast_10peer_deliveries_per_sec": round(tree_msgs_per_sec, 1),

@@ -30,11 +30,18 @@ import numpy as np
 from ..config import GossipSubParams, ScoreParams
 from ..ops import bitpack
 from ..ops import gossip_packed as gossip_ops
+from ..ops import histogram as hist_ops
 from ..ops import scoring as scoring_ops
 from ..ops.gossip import heartbeat_mesh
 from ..ops.graphs import safe_gather, top_mask
 from ..ops.px import px_rewire
 from ..ops.scoring import GlobalCounters, TopicCounters
+
+# Flight-recorder latency histogram width (rounds).  One bin per round of
+# latency with the tail clipped into the last bin: quantiles from the
+# histogram match nanpercentile over raw latencies exactly while the rollout
+# is shorter than this (see ops/histogram.py).
+FLIGHT_HIST_BINS = 32
 
 
 class GossipState(NamedTuple):
@@ -812,7 +819,7 @@ class GossipSub:
             key=knext,
         )
 
-    def _propagate(self, st: GossipState) -> GossipState:
+    def _propagate(self, st: GossipState, with_receipts: bool = False):
         # Fold due gossip/flood deliveries (granted or offered last round)
         # into this round's receipts.  These copies arrive this round and
         # relay NEXT round (they join fresh_w after the eager push below) —
@@ -899,12 +906,11 @@ class GossipSub:
             )
         # One [N, M] stamping pass for both receipt sources (pend fold +
         # eager push): both record the same step, so the union stamps once.
-        first_step = jnp.where(
+        stamped = (
             bitpack.unpack(gossip_new | out.new_w, self.m)
-            & (st.first_step < 0),
-            st.step,
-            st.first_step,
+            & (st.first_step < 0)
         )
+        first_step = jnp.where(stamped, st.step, st.first_step)
         c = st.counters._replace(
             first_message_deliveries=st.counters.first_message_deliveries
             + out.fmd_inc,
@@ -933,7 +939,7 @@ class GossipSub:
                 st.fresh_hist, new_fresh[:, None, :],
                 (jnp.int32(0), jnp.mod(st.step, dpl), jnp.int32(0)),
             )
-        return st._replace(
+        nxt = st._replace(
             have_w=out.have_w,
             # Pend-fold arrivals relay on the NEXT round (one hop per round).
             fresh_w=new_fresh,
@@ -944,6 +950,22 @@ class GossipSub:
             iwant_pend_w=jnp.zeros_like(st.iwant_pend_w),
             pend_hold=pend_hold,
         )
+        if not with_receipts:
+            return nxt
+        # Flight-recorder tap: per-message counts of the receipts stamped
+        # this round, masked the way the latency histogram counts them.
+        # Reusing ``stamped`` here fuses the count into the stamping pass —
+        # any re-derivation from the post-step table costs a fresh [N, M]
+        # pass per round (see ops.histogram.latency_histogram_increment).
+        # The masks are stable inside a round (alive/subscribed/msg_used
+        # flip only through the host API, msg_valid only at publish), so
+        # pre-step masks equal post-step masks.
+        counted = (
+            stamped
+            & (st.alive & st.subscribed)[:, None]
+            & (st.msg_used & st.msg_valid)[None, :]
+        )
+        return nxt, counted.sum(axis=0, dtype=jnp.int32)
 
     @functools.partial(jax.jit, static_argnums=0)
     def step(self, st: GossipState) -> GossipState:
@@ -958,13 +980,121 @@ class GossipSub:
         )
         return st._replace(step=st.step + 1)
 
+    @functools.partial(jax.jit, static_argnums=0)
+    def step_recorded(self, st: GossipState):
+        """``step`` plus the flight recorder's receipt tap: returns
+        ``(next state, i32[M] count of receipts first stamped this round)``.
+
+        The state result is computed by the exact same graph as ``step``
+        (the tap only adds a reduction over the stamping mask ``_propagate``
+        already builds), so a recorded rollout stays bit-identical to a
+        bare one.
+        """
+        st, per_msg = self._propagate(st, with_receipts=True)
+        st = jax.lax.cond(
+            (st.step % self.heartbeat_steps) == self.heartbeat_steps - 1,
+            self._heartbeat,
+            lambda s: s,
+            st,
+        )
+        return st._replace(step=st.step + 1), per_msg
+
     @functools.partial(jax.jit, static_argnames=("self", "n_steps"))
     def run(self, st: GossipState, n_steps: int) -> GossipState:
-        def body(s, _):
-            return self.step(s), None
+        return self.rollout(st, n_steps, record=False)[0]
 
-        st, _ = jax.lax.scan(body, st, None, length=n_steps)
-        return st
+    @functools.partial(jax.jit, static_argnames=("self", "n_steps", "record"))
+    def rollout(self, st: GossipState, n_steps: int, record: bool = True):
+        """``n_steps`` rounds -> (final state, flight record | None).
+
+        With ``record=True`` every round emits the compact metrics pytree of
+        ``flight_record_round`` as the scan's ``ys`` — each leaf comes back
+        stacked with a leading [n_steps] round axis, entirely device-side
+        (no host transfer inside the scan; one ``device_get`` of the whole
+        record costs ~n_steps * (9 scalars + one i32[FLIGHT_HIST_BINS]
+        histogram)).  The cumulative latency histogram rides the scan CARRY:
+        seeded once from the full stamp table, then advanced per round by
+        the receipts stamped that round (``latency_histogram_increment``) —
+        the one-shot [N*M] segment_sum costs about as much as a whole
+        propagate round at 16k peers, so recomputing it per round would
+        double the rollout (and ``latency_histogram_seed`` skips even the
+        one-time scatter on fresh-publish states, where the seed is a
+        scalar count of latency-zero publisher stamps).  Peers dead at
+        rollout end may therefore still
+        have receipts counted (they were alive when stamped) — matching
+        what a per-round sampler observes, not a retroactive recount.
+        ``record=False`` is the bench's bare rollout: the scan carries no
+        histogram and no ys, so the recorder-off path is byte-identical to
+        the old ``run``.
+        """
+        if not record:
+            def bare(s, _):
+                return self.step(s), None
+
+            return jax.lax.scan(bare, st, None, length=n_steps)
+
+        hist0 = hist_ops.latency_histogram_seed(
+            st.first_step, st.msg_birth, st.msg_used & st.msg_valid,
+            st.alive & st.subscribed, FLIGHT_HIST_BINS,
+        )
+
+        def body(carry, _):
+            s, hist = carry
+            # step() stamps new receipts with the PRE-increment round
+            # counter (s.step == s2.step - 1), so every receipt counted in
+            # per_msg shares the latency s.step - msg_birth.
+            s2, per_msg = self.step_recorded(s)
+            hist = hist + hist_ops.latency_histogram_increment(
+                per_msg, s2.msg_birth, s2.msg_used & s2.msg_valid,
+                s.step, FLIGHT_HIST_BINS,
+            )
+            return (s2, hist), self.flight_record_round(s2, hist)
+
+        (final, _), record_ys = jax.lax.scan(
+            body, (st, hist0), None, length=n_steps
+        )
+        return final, record_ys
+
+    # -- flight recorder ----------------------------------------------------
+
+    def flight_record_round(self, st: GossipState, lat_hist: jax.Array):
+        """One round's telemetry as a dict of device scalars (+ one i32[B]
+        latency histogram) — the per-round sample the rollout scan stacks.
+
+        ``lat_hist`` is the cumulative receipt histogram the rollout scan
+        carries (see ``rollout``); it doubles as the delivery count
+        (``lat_hist.sum()`` == receipts), so delivery fraction costs
+        nothing extra.  Everything else is a cheap reduction over state the
+        round already computed.  Score quantiles are taken over each peer's
+        MEAN live-neighbor score, via the binned-histogram quantile rather
+        than an [N] sort — XLA's CPU sort alone would eat most of the
+        recorder's overhead budget, and a 128-bin approximation (error <=
+        one bin of the per-round score range) is plenty for a
+        score-distribution time series.
+        """
+        part = st.alive & st.subscribed
+        part_n = jnp.maximum(part.sum(), 1)
+        in_window = st.msg_used & st.msg_valid
+        n_msgs = jnp.maximum(in_window.sum(), 1)
+        mesh_deg = (st.mesh & st.nbr_valid).sum(axis=1)
+        deg_alive = jnp.where(part, mesh_deg, 0)
+        live_slots = jnp.maximum(st.nbr_valid.sum(axis=1), 1)
+        peer_score = (
+            jnp.where(st.nbr_valid, st.scores, 0.0).sum(axis=1) / live_slots
+        )
+        score_q = hist_ops.binned_quantiles(peer_score, part, (0.1, 0.5, 0.9))
+        return {
+            "step": st.step,
+            "peers_alive": st.alive.sum(),
+            "delivery_frac": lat_hist.sum() / (part_n * n_msgs),
+            "mesh_degree_mean": deg_alive.sum() / part_n,
+            "mesh_degree_max": mesh_deg.max(),
+            "score_p10": score_q[0],
+            "score_p50": score_q[1],
+            "score_p90": score_q[2],
+            "gossip_pending": bitpack.popcount(st.gossip_pend_w).sum(),
+            "lat_hist": lat_hist,
+        }
 
     # -- metrics ------------------------------------------------------------
 

@@ -126,7 +126,9 @@ def multitopic_state_shardings(st: MultiTopicState, mesh, n_peers: int):
                 )
     return state_shardings(
         st, mesh, replicated=MULTITOPIC_REPLICATED_FIELDS,
-        peer_dim=MULTITOPIC_PEER_DIMS,
+        peer_dim={
+            **{f: 0 for f in _MT_PEER_DIM0_FIELDS}, **MULTITOPIC_PEER_DIMS
+        },
     )
 
 

@@ -8,6 +8,7 @@ point and benchmarks.
 
 from __future__ import annotations
 
+import functools
 from typing import Tuple
 
 import jax
@@ -32,6 +33,25 @@ class TreeCast:
     def forward(state: TreeState) -> TreeState:
         """One lockstep network transition — the jittable hot path."""
         return tree_ops.step(state)
+
+    @functools.partial(jax.jit, static_argnames=("self", "n_steps", "record"))
+    def rollout(self, state: TreeState, n_steps: int, record: bool = True):
+        """``n_steps`` lockstep transitions -> (final state, flight record).
+
+        The tree plane's flight recorder (the GossipSub.rollout twin): with
+        ``record=True`` each step emits the ``tree_metrics`` reduction dict
+        as the scan's ``ys``, so join/repair convergence and delivery
+        backlog come back as [n_steps] time series with no host transfer
+        inside the scan.  ``record=False`` carries no ys (the bare rollout
+        ``tree_ops.run_steps`` always was).
+        """
+        from ..utils.metrics import tree_metrics
+
+        def body(s, _):
+            s = tree_ops.step(s)
+            return s, (tree_metrics(s) if record else None)
+
+        return jax.lax.scan(body, state, None, length=n_steps)
 
     def build_demo_state(self, n_peers: int, n_msgs: int = 4) -> TreeState:
         """A small joined tree with queued traffic, for compile checks/bench.

@@ -38,16 +38,25 @@ sim engine so both planes behave identically:
 from __future__ import annotations
 
 import asyncio
+import json
 import threading
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..config import SUB_REPAIR_TIMEOUT_S, DELIVERY_BUFFER, TreeOpts
 from ..crypto.pipeline import Envelope, ValidationPipeline, sign_envelope
+from ..utils.log import get_logger, kv
+from ..utils.metrics import MetricsRegistry
 from ..wire import Message, MessageType
 from .transport import LiveHost, Peerstore, Stream, StreamClosed
 
 MAX_JOIN_HOPS = 64  # bound on the redirect walk (reference: unbounded recursion)
+
+# The host plane's structured logger (the go-log "pubsub" analog, §5.5):
+# protocol events — join admission/redirect, child drops, repair adoptions,
+# rejoins — log here with key=value fields; per-message publish stays at
+# DEBUG so the data plane never pays formatting at INFO.
+_log = get_logger("live")
 
 
 class _BatchValidator:
@@ -181,12 +190,14 @@ class _TreeNode:
         protoid: str,
         opts: TreeOpts,
         repair_timeout_s: float = SUB_REPAIR_TIMEOUT_S,
+        metrics: Optional[MetricsRegistry] = None,
     ) -> None:
         self.host = host
         self.protoid = protoid
         self.width = opts.tree_width
         self.max_width = opts.tree_max_width
         self.repair_timeout_s = repair_timeout_s
+        self.metrics = metrics  # shared registry (the /metrics counters)
         self.children: Dict[str, _Child] = {}
         self.chlock = asyncio.Lock()  # chlock (subtree.go:18) — held on ALL
         # admission paths, fixing the reference's unlocked Part path (§2.4.7)
@@ -194,6 +205,10 @@ class _TreeNode:
         self.pause: asyncio.Queue = asyncio.Queue(maxsize=4)  # repair handoff
         self.root_id: Optional[str] = None  # for rejoin-at-root
         self.closed = False
+
+    def _inc(self, name: str) -> None:
+        if self.metrics is not None:
+            self.metrics.inc(name)
 
     # -- accounting ----------------------------------------------------------
 
@@ -257,6 +272,14 @@ class _TreeNode:
             stale.stream.close()
         child = _Child(stream=s)
         self.children[s.remote_peer] = child
+        self._inc("live.join_admitted")
+        _log.info(
+            "join_admitted",
+            extra=kv(
+                parent=self.host.id, child=s.remote_peer, prio=prio,
+                children=len(self.children),
+            ),
+        )
         self.host.spawn(self._handle_child_messages(s.remote_peer, child))
         await self.notify_parent_state()
 
@@ -268,6 +291,11 @@ class _TreeNode:
         # redirects spread (subtree.go:176-178); sizes here are corrected by
         # the next real State, so the increment is the same heuristic.
         self.children[minc].size += 1
+        self._inc("live.join_redirected")
+        _log.info(
+            "join_redirected",
+            extra=kv(parent=self.host.id, child=s.remote_peer, to=minc),
+        )
         try:
             await s.write_message(Message(type=MessageType.UPDATE, peers=[minc]))
         except StreamClosed:
@@ -307,6 +335,14 @@ class _TreeNode:
         if self.children.get(cid) is not child:
             return
         del self.children[cid]
+        self._inc("live.child_dropped")
+        _log.info(
+            "child_dropped",
+            extra=kv(
+                parent=self.host.id, child=cid,
+                orphans=len(child.child_ids),
+            ),
+        )
         await self._redistribute(child.child_ids)
         await self.notify_parent_state()
 
@@ -326,6 +362,11 @@ class _TreeNode:
                 if self.closed or gid in self.children:
                     s.close()
                     continue
+                self._inc("live.repair_adopted")
+                _log.info(
+                    "repair_adopted",
+                    extra=kv(parent=self.host.id, grandchild=gid),
+                )
                 await self.handle_join(s, prio=True)
 
     # -- data plane ----------------------------------------------------------
@@ -450,7 +491,7 @@ class LiveTopic:
         self.tm = tm
         self.title = title
         self.protoid = f"{tm.host.id}/{title}"  # (root, title) namespacing
-        self.node = _TreeNode(tm.host, self.protoid, opts)
+        self.node = _TreeNode(tm.host, self.protoid, opts, metrics=tm.registry)
         # Publisher identity: with a seed, every publish travels as a signed
         # Envelope (crypto/pipeline) inside the Data frame — the fix for the
         # reference's `// TODO: add signature` (pubsub.go:117).
@@ -485,6 +526,11 @@ class LiveTopic:
             )
             self._seqno += 1
             data = env.to_wire()
+        self.node._inc("live.msgs_published")
+        _log.debug(
+            "publish",
+            extra=kv(topic=self.title, root=self.tm.host.id, bytes=len(data)),
+        )
         await self.node.forward_message(Message(type=MessageType.DATA, data=data))
 
     async def close(self) -> None:
@@ -518,6 +564,7 @@ class LiveSubscription:
             self.protoid,
             TreeOpts(),
             repair_timeout_s=repair_timeout_s,
+            metrics=tm.registry,
         )
         self.node.root_id = root_id
         # client.out, cap 16 (client.go:79): a full queue blocks the receive
@@ -603,6 +650,11 @@ class LiveSubscription:
 
     async def _rejoin_root(self) -> bool:
         """``rejoinRoot`` — implemented (vs ``panic``, ``client.go:96-98``)."""
+        self.node._inc("live.rejoin_root")
+        _log.info(
+            "rejoin_root",
+            extra=kv(peer=self.tm.host.id, root=self.node.root_id),
+        )
         try:
             s = await self.tm.host.new_stream(self.node.root_id, self.protoid)
             self.node.parent_stream = await self.node.join_to_peer(s)
@@ -623,12 +675,24 @@ class LiveSubscription:
 
 
 class LiveTopicManager:
-    """Topic registry on one live host (``TopicManager``, ``pubsub.go:19-31``)."""
+    """Topic registry on one live host (``TopicManager``, ``pubsub.go:19-31``).
 
-    def __init__(self, host: LiveHost, repair_timeout_s: float = SUB_REPAIR_TIMEOUT_S):
+    ``registry`` (optional, usually shared across a whole network) collects
+    the plane's protocol counters — joins, redirects, drops, repairs,
+    publishes — for the ``/metrics`` endpoint.
+    """
+
+    def __init__(
+        self,
+        host: LiveHost,
+        repair_timeout_s: float = SUB_REPAIR_TIMEOUT_S,
+        registry: Optional[MetricsRegistry] = None,
+    ):
         self.host = host
         self.repair_timeout_s = repair_timeout_s
+        self.registry = registry
         self.topics: Dict[str, LiveTopic] = {}
+        self.subscriptions: List[LiveSubscription] = []
 
     async def new_topic(
         self,
@@ -647,7 +711,123 @@ class LiveTopicManager:
             self, root_id, title, self.repair_timeout_s, validate=validate
         )
         await sub.start()
+        self.subscriptions.append(sub)
         return sub
+
+
+# ---------------------------------------------------------------------------
+# observability endpoint: /metrics (Prometheus) + /debug/tree (JSON)
+# ---------------------------------------------------------------------------
+
+
+class MetricsHTTPServer:
+    """Minimal asyncio HTTP/1.0 server exposing the live plane's telemetry.
+
+    - ``GET /metrics``     Prometheus text exposition of the shared
+      :class:`MetricsRegistry` (counters from the protocol sites above plus
+      whatever gauges the host recorded, e.g. ``observe_state`` snapshots of
+      a device sim riding alongside).
+    - ``GET /debug/tree``  JSON topology snapshot per registered topic
+      manager — the servable descendant of the reference's private
+      ``printTree`` debugger (``pubsub_test.go:204-229``): each topic's
+      children (with subtree sizes) and each subscription's current parent.
+
+    Request parsing is deliberately tiny (request line + drained headers):
+    the endpoint serves scrape loops and humans with curl, not general HTTP.
+    """
+
+    def __init__(
+        self,
+        registry: MetricsRegistry,
+        sources: Optional[Callable[[], Dict[str, LiveTopicManager]]] = None,
+        bind: str = "127.0.0.1",
+    ):
+        self.registry = registry
+        self._sources = sources or (lambda: {})
+        self._bind = bind
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.port: Optional[int] = None
+
+    async def start(self) -> int:
+        self._server = await asyncio.start_server(self._handle, self._bind, 0)
+        self.port = self._server.sockets[0].getsockname()[1]
+        _log.info("metrics_listening", extra=kv(bind=self._bind, port=self.port))
+        return self.port
+
+    async def aclose(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            self._server = None
+
+    def tree_snapshot(self) -> Dict[str, dict]:
+        snap: Dict[str, dict] = {}
+        for host_id, tm in self._sources().items():
+            topics = {
+                title: {
+                    "subtree_size": t.node.subtree_size(),
+                    "children": {
+                        cid: c.size
+                        for cid, c in t.node.children.items()
+                        if not c.dead
+                    },
+                }
+                for title, t in tm.topics.items()
+            }
+            subs = {}
+            for sub in tm.subscriptions:
+                ps = sub.node.parent_stream
+                subs[sub.protoid] = {
+                    "parent": (
+                        ps.remote_peer if ps is not None and not ps.closed
+                        else None
+                    ),
+                    "subtree_size": sub.node.subtree_size(),
+                    "children": {
+                        cid: c.size
+                        for cid, c in sub.node.children.items()
+                        if not c.dead
+                    },
+                }
+            snap[host_id] = {"topics": topics, "subscriptions": subs}
+        return snap
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            request = await reader.readline()
+            parts = request.decode("ascii", errors="replace").split()
+            path = parts[1] if len(parts) >= 2 else "/"
+            while True:  # drain request headers
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            if path == "/metrics":
+                status, ctype = "200 OK", "text/plain; version=0.0.4"
+                body = self.registry.render_prometheus().encode()
+            elif path == "/debug/tree":
+                status, ctype = "200 OK", "application/json"
+                body = json.dumps(self.tree_snapshot(), sort_keys=True).encode()
+            else:
+                status, ctype = "404 Not Found", "text/plain"
+                body = b"not found\n"
+            writer.write(
+                (
+                    f"HTTP/1.0 {status}\r\n"
+                    f"Content-Type: {ctype}\r\n"
+                    f"Content-Length: {len(body)}\r\n"
+                    f"Connection: close\r\n\r\n"
+                ).encode()
+                + body
+            )
+            await writer.drain()
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
 
 
 # ---------------------------------------------------------------------------
@@ -666,6 +846,9 @@ class LiveNetwork:
     ):
         self.peerstore = Peerstore(validate_ids=validate_ids)
         self.repair_timeout_s = repair_timeout_s
+        self.registry = MetricsRegistry()
+        self._sync_hosts: List["SyncHost"] = []
+        self._metrics_server: Optional[MetricsHTTPServer] = None
         self._loop = asyncio.new_event_loop()
         self._thread = threading.Thread(target=self._loop.run_forever, daemon=True)
         self._thread.start()
@@ -673,6 +856,22 @@ class LiveNetwork:
 
     def call(self, coro, timeout: float = 30.0):
         return asyncio.run_coroutine_threadsafe(coro, self._loop).result(timeout)
+
+    def serve_metrics(self, bind: str = "127.0.0.1") -> Tuple[str, int]:
+        """Start the ``/metrics`` + ``/debug/tree`` endpoint; return (host, port).
+
+        One endpoint per network: all hosts share the network registry, and
+        the topology snapshot covers every host created via :meth:`host`.
+        """
+        if self._metrics_server is None:
+            srv = MetricsHTTPServer(
+                self.registry,
+                sources=lambda: {h.id: h.tm for h in self._sync_hosts},
+                bind=bind,
+            )
+            self.call(srv.start())
+            self._metrics_server = srv
+        return self._metrics_server._bind, self._metrics_server.port
 
     def host(self) -> "SyncHost":
         if self.peerstore.validate_ids:
@@ -694,6 +893,12 @@ class LiveNetwork:
         return [self.host() for _ in range(count)]
 
     def shutdown(self) -> None:
+        if self._metrics_server is not None:
+            try:
+                self.call(self._metrics_server.aclose())
+            except Exception:
+                pass
+            self._metrics_server = None
         self._loop.call_soon_threadsafe(self._loop.stop)
         self._thread.join(timeout=5)
 
@@ -705,7 +910,10 @@ class SyncHost:
         self.net = net
         self.live = host
         self.id = host.id
-        self.tm = LiveTopicManager(host, repair_timeout_s=net.repair_timeout_s)
+        self.tm = LiveTopicManager(
+            host, repair_timeout_s=net.repair_timeout_s, registry=net.registry
+        )
+        net._sync_hosts.append(self)
 
     def new_topic(
         self,

@@ -94,14 +94,21 @@ class TreeState(NamedTuple):
 
 
 # Field-name sharding classification for the peer-dimension parallel path
-# (see parallel/mesh.py): every field is per-peer (leading dim N) except
-# these.  By NAME, not shape, so a non-peer array (like the [2] PRNG key)
-# can never be silently sharded — adding a TreeState field forces a
-# decision here (parallel.mesh.state_shardings errors on unclassified
-# non-peer leaves).
+# (see parallel/mesh.py).  Exhaustive and by NAME, not shape, so a non-peer
+# array (like the [2] PRNG key) can never be silently sharded — adding a
+# TreeState field forces a decision here (parallel.mesh.state_shardings
+# errors on any unclassified field).
 TREE_REPLICATED_FIELDS = frozenset(
     {"key", "root", "width", "max_width", "step_num"}
 )
+TREE_PEER_DIMS = {
+    name: 0
+    for name in (
+        "parent", "children", "alive", "joined", "leaving", "join_target",
+        "join_prio", "join_wait", "subtree_size", "q", "q_when", "q_head",
+        "q_len", "out", "out_len", "out_drained", "edge_delay", "edge_drop",
+    )
+}
 
 
 def init_state(

@@ -87,7 +87,11 @@ def gossip_state_shardings(
                 )
     from .mesh import state_shardings
 
-    return state_shardings(st, mesh, axis, replicated=_REPLICATED_FIELDS)
+    return state_shardings(
+        st, mesh, axis,
+        replicated=_REPLICATED_FIELDS,
+        peer_dim={f: 0 for f in _PEER_DIM_FIELDS},
+    )
 
 
 class ShardedGossipSub:

@@ -67,25 +67,20 @@ def state_shardings(
     """NamedSharding pytree matching ``state``: peer-dim arrays sharded,
     scalars replicated.  Peer-dim sizes must divide the mesh size.
 
-    For NamedTuple states, ``replicated`` names the non-scalar fields that
-    must NOT shard (e.g. a PRNG key) — classification by field name, not
-    shape, so a non-peer array can never be silently sharded (the rule
-    ``gossip_sharded`` pioneered for ``GossipState``).  Pass the set defined
-    next to the state type (``ops.tree.TREE_REPLICATED_FIELDS``).  Two
-    validations back the claim that misclassification cannot pass silently:
-    ``replicated`` names must all be real fields (typos error), and every
-    non-replicated non-scalar leaf must share one leading (peer) dimension —
-    a forgotten classification of a non-peer array (a [2] PRNG key, an [M]
-    message-window table) fails the uniformity check regardless of
-    divisibility, UNLESS its leading dim coincidentally equals the peer dim
-    (e.g. msg_window == n_peers), in which case it is silently sharded —
-    so classify every non-peer field explicitly rather than relying on the
-    check to catch omissions.
-
-    ``peer_dim`` (NamedTuple states only) maps field names whose peer
-    dimension is NOT the leading one to its axis position — e.g. multitopic
-    state stacks per-topic leaves as [T, N, ...], so those fields pass
-    ``{name: 1}`` (``models.multitopic.MULTITOPIC_PEER_DIMS``).
+    For NamedTuple states the classification must be EXHAUSTIVE: every field
+    is named either in ``replicated`` (must NOT shard — PRNG keys, message
+    metadata, scalars) or in ``peer_dim`` (shards; the dict maps field name
+    to the axis position of its peer dimension, 0 for leading, e.g. 1 for
+    multitopic's [T, N, ...] stacks).  By NAME, not shape: earlier versions
+    inferred peer fields from leading-shape uniformity, which silently
+    sharded any forgotten non-peer array whose leading dim happened to equal
+    the peer dim (msg_window == n_peers — a real hazard, not a hypothetical).
+    An unclassified field, an unknown name (typo), or a field named in both
+    sets is an error, so adding a state field forces a sharding decision at
+    the classification site (``ops.tree.TREE_PEER_DIMS``,
+    ``gossip_sharded._PEER_DIM_FIELDS``, ``multitopic.MULTITOPIC_PEER_DIMS``).
+    As a final cross-check, all peer-dim leaves must agree on one peer
+    dimension size.
     """
     n = mesh.shape[axis]
     repl = NamedSharding(mesh, P())
@@ -104,33 +99,45 @@ def state_shardings(
         return NamedSharding(mesh, spec)
 
     if hasattr(state, "_fields"):
-        peer_dim = peer_dim or {}
-        unknown = (replicated | set(peer_dim)) - set(state._fields)
+        peer_dim = dict(peer_dim or {})
+        fields = set(state._fields)
+        unknown = (replicated | set(peer_dim)) - fields
         if unknown:
             raise ValueError(
                 f"classified names not in {type(state).__name__}: "
                 f"{sorted(unknown)}"
             )
-        peer_dims = {
-            leaf.shape[peer_dim.get(name, 0)]
-            for name in state._fields
-            if name not in replicated
+        both = replicated & set(peer_dim)
+        if both:
+            raise ValueError(
+                f"fields classified both replicated and peer-dim: "
+                f"{sorted(both)}"
+            )
+        unclassified = fields - replicated - set(peer_dim)
+        if unclassified:
+            raise ValueError(
+                f"{type(state).__name__} fields without a sharding rule: "
+                f"{sorted(unclassified)}; name every field in `replicated=` "
+                f"or `peer_dim=` (see ops.tree.TREE_PEER_DIMS)"
+            )
+        peer_sizes = {
+            leaf.shape[d]
+            for name, d in peer_dim.items()
             for leaf in jax.tree.leaves(getattr(state, name))
             # ndim > dim so a misclassified low-rank leaf reaches one()'s
             # named ValueError instead of a bare IndexError here.
-            if getattr(leaf, "ndim", 0) > peer_dim.get(name, 0)
+            if getattr(leaf, "ndim", 0) > d
         }
-        if len(peer_dims) > 1:
+        if len(peer_sizes) > 1:
             raise ValueError(
-                f"non-replicated leaves of {type(state).__name__} disagree "
-                f"on the peer dimension ({sorted(peer_dims)}); classify the "
-                f"non-peer fields via `replicated=` (e.g. "
-                f"ops.tree.TREE_REPLICATED_FIELDS)"
+                f"peer-dim leaves of {type(state).__name__} disagree on the "
+                f"peer dimension size ({sorted(peer_sizes)}); check the "
+                f"`peer_dim=` classification"
             )
         return type(state)(**{
             name: jax.tree.map(
                 (lambda x: repl) if name in replicated
-                else (lambda x, d=peer_dim.get(name, 0): one(x, d)),
+                else (lambda x, d=peer_dim[name]: one(x, d)),
                 getattr(state, name),
             )
             for name in state._fields

@@ -17,6 +17,7 @@ Two pieces:
 from __future__ import annotations
 
 import json
+import re
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -85,6 +86,35 @@ def snapshot(metrics: Dict[str, jax.Array]) -> Dict[str, float]:
     return {k: float(v) for k, v in host.items()}
 
 
+def flight_summary(record: Dict[str, jax.Array]) -> Dict[str, Any]:
+    """Host-side digest of a rollout flight record (one ``device_get``).
+
+    ``record`` is the stacked ys pytree of ``GossipSub.rollout(record=True)``
+    (or the treecast twin): scalar series come back as plain float lists
+    keyed by name, and when a ``lat_hist`` series is present its FINAL row
+    (the cumulative receipt histogram at rollout end) is kept alongside
+    histogram-derived p50/p99 — the same quantile arithmetic
+    ``delivery_stats`` computes from the raw [N, M] table, at i32[B] cost.
+    This is the dict the bench embeds in its JSON line.
+    """
+    import numpy as np
+
+    from ..ops.histogram import hist_quantile
+
+    host = jax.device_get(record)
+    out: Dict[str, Any] = {"series": {}}
+    for name, arr in sorted(host.items()):
+        a = np.asarray(arr)
+        if a.ndim == 1:
+            out["series"][name] = [round(float(v), 6) for v in a]
+    if "lat_hist" in host:
+        final = np.asarray(host["lat_hist"])[-1]
+        out["lat_hist"] = [int(v) for v in final]
+        out["lat_p50"] = float(hist_quantile(jnp.asarray(final), 0.5))
+        out["lat_p99"] = float(hist_quantile(jnp.asarray(final), 0.99))
+    return out
+
+
 # ---------------------------------------------------------------------------
 # host-side registry
 # ---------------------------------------------------------------------------
@@ -125,3 +155,37 @@ class MetricsRegistry:
         for name, series in self._series.items():
             out[f"gauge.{name}"] = series[-1][1]
         return json.dumps(out, sort_keys=True)
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition (format version 0.0.4) of all counters
+        and the latest sample of every gauge series — the body the live
+        plane's ``/metrics`` endpoint serves.  Names are sanitized to the
+        metric grammar (dots and other illegal runes become ``_``); counters
+        get the conventional ``_total`` suffix."""
+        lines: List[str] = []
+        for name in sorted(self._counters):
+            pn = _prometheus_name(name) + "_total"
+            lines.append(f"# TYPE {pn} counter")
+            lines.append(f"{pn} {_prometheus_value(self._counters[name])}")
+        for name in sorted(self._series):
+            pn = _prometheus_name(name)
+            lines.append(f"# TYPE {pn} gauge")
+            lines.append(f"{pn} {_prometheus_value(self._series[name][-1][1])}")
+        return "\n".join(lines) + "\n"
+
+
+def _prometheus_name(name: str) -> str:
+    """Sanitize to the metric-name grammar ``[a-zA-Z_:][a-zA-Z0-9_:]*``."""
+    name = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    if not name or not re.match(r"[a-zA-Z_:]", name[0]):
+        name = "_" + name
+    return name
+
+
+def _prometheus_value(v: float) -> str:
+    f = float(v)
+    if f != f:
+        return "NaN"
+    if f in (float("inf"), float("-inf")):
+        return "+Inf" if f > 0 else "-Inf"
+    return repr(int(f)) if f.is_integer() else repr(f)

@@ -12,8 +12,9 @@ host structures for inspection.
 from __future__ import annotations
 
 import contextlib
+import json
 import time
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -25,10 +26,18 @@ class StepTimer:
     ``with timer("propagate"): st = gs.step(st)`` — each phase records a
     wall-time sample; device work is fenced with ``block_until_ready`` on the
     value passed to ``fence`` (or skipped if none is set before exit).
+
+    Every sample also keeps its start offset from the timer's construction,
+    so the full phase timeline can be exported as a Chrome-trace /
+    Perfetto-loadable JSON (``export_chrome_trace``) — the bench's phase
+    breakdown becomes a viewable flame track instead of a flat dict.
     """
 
     def __init__(self):
         self.samples: Dict[str, List[float]] = {}
+        # (name, start offset s, duration s) in completion order.
+        self.events: List[Tuple[str, float, float]] = []
+        self._epoch = time.perf_counter()
         self._fence_val: Any = None
 
     def fence(self, value: Any) -> Any:
@@ -45,7 +54,9 @@ class StepTimer:
             if self._fence_val is not None:
                 jax.block_until_ready(self._fence_val)
                 self._fence_val = None
-            self.samples.setdefault(name, []).append(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self.samples.setdefault(name, []).append(dt)
+            self.events.append((name, t0 - self._epoch, dt))
 
     def stats(self) -> Dict[str, Dict[str, float]]:
         out = {}
@@ -59,6 +70,28 @@ class StepTimer:
                 "max_ms": float(a.max() * 1e3),
             }
         return out
+
+    def export_chrome_trace(self) -> str:
+        """The recorded phases as Chrome trace-event JSON (complete "X"
+        events, microsecond timestamps) — loadable in ``chrome://tracing``
+        and Perfetto.  One process/thread track: the timer measures the
+        host-side dispatch timeline, not per-device streams (use
+        ``xla_trace`` for XLA-level tracks)."""
+        events = [
+            {
+                "name": name,
+                "cat": "phase",
+                "ph": "X",
+                "ts": round(start * 1e6, 3),
+                "dur": round(dur * 1e6, 3),
+                "pid": 0,
+                "tid": 0,
+            }
+            for name, start, dur in self.events
+        ]
+        return json.dumps(
+            {"traceEvents": events, "displayTimeUnit": "ms"}, sort_keys=True
+        )
 
 
 @contextlib.contextmanager

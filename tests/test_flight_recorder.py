@@ -1,0 +1,294 @@
+"""Flight recorder suite: in-scan telemetry, device histograms, exporters.
+
+Three layers under test, matching the recorder's data path:
+
+- device: ``GossipSub.rollout(record=True)`` emits per-round series as the
+  scan's ys with exact-parity contracts — the cumulative latency histogram
+  equals the one-shot recount on the final state, its p50/p99 equal
+  ``delivery_stats``'s numpy-percentile arithmetic, and ``record=False``
+  stays bit-identical to the bare ``run`` (the recorder must never perturb
+  the simulation it observes);
+- host: ``MetricsRegistry.render_prometheus`` speaks text exposition 0.0.4
+  and ``StepTimer.export_chrome_trace`` emits Perfetto-loadable JSON;
+- wire: the live plane's ``/metrics`` + ``/debug/tree`` endpoint round-trips
+  over a real socket.
+"""
+
+import json
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu.models.gossipsub import (
+    FLIGHT_HIST_BINS,
+    GossipSub,
+)
+from go_libp2p_pubsub_tpu.models.treecast import TreeCast
+from go_libp2p_pubsub_tpu.ops import histogram as hist_ops
+from go_libp2p_pubsub_tpu.utils.metrics import (
+    MetricsRegistry,
+    flight_summary,
+)
+from go_libp2p_pubsub_tpu.utils.trace import StepTimer
+
+N_STEPS = 12
+
+
+@pytest.fixture(scope="module")
+def recorded():
+    """One recorded rollout on a small deterministic mesh, shared by the
+    device-layer tests: (model, start state, final state, record)."""
+    gs = GossipSub(n_peers=128, n_slots=16, conn_degree=8, msg_window=16)
+    st = gs.init(seed=0)
+    rng = np.random.default_rng(7)
+    for slot in range(8):
+        st = gs.publish(
+            st, jnp.int32(int(rng.integers(128))), jnp.int32(slot),
+            jnp.asarray(True),
+        )
+    final, rec = gs.rollout(st, N_STEPS, record=True)
+    return gs, st, final, jax.device_get(rec)
+
+
+def test_flight_series_shapes(recorded):
+    gs, st, final, rec = recorded
+    scalar_series = [
+        "step", "peers_alive", "delivery_frac", "mesh_degree_mean",
+        "mesh_degree_max", "score_p10", "score_p50", "score_p90",
+        "gossip_pending",
+    ]
+    for name in scalar_series:
+        assert rec[name].shape == (N_STEPS,), name
+    assert rec["lat_hist"].shape == (N_STEPS, FLIGHT_HIST_BINS)
+    assert len(scalar_series) >= 6  # the tentpole's series floor
+
+
+def test_flight_series_values(recorded):
+    gs, st, final, rec = recorded
+    # step counts every round; no deaths on this mesh.
+    np.testing.assert_array_equal(rec["step"], np.arange(1, N_STEPS + 1))
+    np.testing.assert_array_equal(rec["peers_alive"], np.full(N_STEPS, 128))
+    # delivery is cumulative: monotone, ends at delivery_stats' mean frac.
+    df = rec["delivery_frac"]
+    assert np.all(np.diff(df) >= 0)
+    frac, _, _ = gs.delivery_stats(final)
+    assert df[-1] == pytest.approx(float(np.nanmean(np.asarray(frac))))
+    assert 0.0 < df[-1] <= 1.0
+    # mesh degree stats bound each other and the slot count.
+    assert np.all(rec["mesh_degree_mean"] <= rec["mesh_degree_max"])
+    assert np.all(rec["mesh_degree_max"] <= 16)
+    # histogram rows are themselves cumulative (receipts never un-happen).
+    assert np.all(np.diff(rec["lat_hist"].sum(axis=1)) >= 0)
+
+
+def test_record_off_is_bit_identical(recorded):
+    gs, st, final, rec = recorded
+    bare, ys = gs.rollout(st, N_STEPS, record=False)
+    assert ys is None
+    for (path, a), b in zip(
+        jax.tree_util.tree_leaves_with_path(bare), jax.tree.leaves(final)
+    ):
+        assert bool(jnp.array_equal(a, b)), jax.tree_util.keystr(path)
+    legacy = gs.run(st, N_STEPS)
+    for a, b in zip(jax.tree.leaves(bare), jax.tree.leaves(legacy)):
+        assert bool(jnp.array_equal(a, b))
+
+
+def test_hist_matches_oneshot_and_bench_percentiles(recorded):
+    """The carried histogram == a recount of the final stamp table, and its
+    quantiles == the numpy percentile arithmetic the bench has always
+    reported (``delivery_stats``) — compression, not approximation."""
+    gs, st, final, rec = recorded
+    oneshot = hist_ops.latency_histogram(
+        final.first_step, final.msg_birth,
+        final.msg_used & final.msg_valid,
+        final.alive & final.subscribed, FLIGHT_HIST_BINS,
+    )
+    np.testing.assert_array_equal(rec["lat_hist"][-1], np.asarray(oneshot))
+    _, p50, p99 = gs.delivery_stats(final)
+    hist = jnp.asarray(rec["lat_hist"][-1])
+    assert float(hist_ops.hist_quantile(hist, 0.5)) == pytest.approx(
+        float(p50), abs=1e-5
+    )
+    assert float(hist_ops.hist_quantile(hist, 0.99)) == pytest.approx(
+        float(p99), abs=1e-5
+    )
+
+
+def test_hist_seed_resume_exact(recorded):
+    """Restarting the recorder from a mid-propagation state takes the slow
+    seed path (receipts with nonzero latency pre-exist) and must still land
+    on the exact recount."""
+    gs, st, _, _ = recorded
+    mid = gs.run(st, 3)
+    final, rec = gs.rollout(mid, 5, record=True)
+    oneshot = hist_ops.latency_histogram(
+        final.first_step, final.msg_birth,
+        final.msg_used & final.msg_valid,
+        final.alive & final.subscribed, FLIGHT_HIST_BINS,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(rec["lat_hist"][-1]), np.asarray(oneshot)
+    )
+
+
+def test_hist_quantile_matches_numpy():
+    rng = np.random.default_rng(3)
+    counts = rng.integers(0, 50, size=FLIGHT_HIST_BINS)
+    values = np.repeat(np.arange(FLIGHT_HIST_BINS), counts).astype(np.float64)
+    for q in (0.0, 0.25, 0.5, 0.99, 1.0):
+        got = float(hist_ops.hist_quantile(jnp.asarray(counts, jnp.int32), q))
+        want = float(np.percentile(values, q * 100.0, method="linear"))
+        assert got == pytest.approx(want, abs=1e-5), q
+    empty = jnp.zeros(FLIGHT_HIST_BINS, jnp.int32)
+    assert np.isnan(float(hist_ops.hist_quantile(empty, 0.5)))
+
+
+def test_binned_quantiles_tolerance():
+    """The score-quantile path errs by at most one bin of the value range."""
+    rng = np.random.default_rng(11)
+    values = jnp.asarray(rng.normal(size=1000) * 5.0, jnp.float32)
+    mask = jnp.asarray(rng.random(1000) < 0.8)
+    qs = (0.1, 0.5, 0.9)
+    got = np.asarray(hist_ops.binned_quantiles(values, mask, qs))
+    want = np.asarray(hist_ops.masked_quantiles(values, mask, qs))
+    v = np.asarray(values)[np.asarray(mask)]
+    bin_w = (v.max() - v.min()) / 127
+    assert np.all(np.abs(got - want) <= bin_w + 1e-6)
+    # degenerate inputs: empty mask -> NaN, constant values -> exact.
+    nothing = jnp.zeros(1000, bool)
+    assert np.all(np.isnan(hist_ops.binned_quantiles(values, nothing, qs)))
+    const = jnp.full(16, 2.5, jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(hist_ops.binned_quantiles(const, jnp.ones(16, bool), qs)),
+        2.5,
+    )
+
+
+def test_treecast_flight_record():
+    tc = TreeCast()
+    st = tc.build_demo_state(10, n_msgs=3)
+    final, rec = tc.rollout(st, 6, record=True)
+    rec = jax.device_get(rec)
+    for name, arr in rec.items():
+        assert arr.shape[0] == 6, name
+    bare, ys = tc.rollout(st, 6, record=False)
+    assert ys is None
+    for a, b in zip(jax.tree.leaves(bare), jax.tree.leaves(final)):
+        assert bool(jnp.array_equal(a, b))
+
+
+def test_flight_summary_digest(recorded):
+    gs, st, final, rec = recorded
+    s = flight_summary(rec)
+    assert s["lat_hist"] == [int(v) for v in rec["lat_hist"][-1]]
+    assert s["lat_p50"] == pytest.approx(
+        float(hist_ops.hist_quantile(jnp.asarray(rec["lat_hist"][-1]), 0.5))
+    )
+    assert len(s["series"]["delivery_frac"]) == N_STEPS
+    json.dumps(s)  # must be JSON-embeddable as-is (the bench line)
+
+
+# ---------------------------------------------------------------------------
+# host-side exporters
+# ---------------------------------------------------------------------------
+
+PROM_NAME = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+def test_prometheus_exposition_format():
+    reg = MetricsRegistry()
+    reg.inc("bench.rollouts")
+    reg.inc("bench.rollouts", 2)
+    reg.gauge("gossip.delivery-frac", 0.5)
+    reg.gauge("weird name!", float("nan"))
+    body = reg.render_prometheus()
+    assert body.endswith("\n")
+    lines = body.splitlines()
+    seen = {}
+    for type_line, sample in zip(lines[::2], lines[1::2]):
+        m = re.match(r"^# TYPE (\S+) (counter|gauge)$", type_line)
+        assert m, type_line
+        name, kind = m.groups()
+        assert PROM_NAME.match(name), name
+        sname, _, value = sample.partition(" ")
+        assert sname == name
+        float(value)  # parses as a Prometheus float (incl. NaN)
+        seen[name] = (kind, value)
+    assert seen["bench_rollouts_total"] == ("counter", "3")
+    assert seen["gossip_delivery_frac"][0] == "gauge"
+    assert seen["weird_name_"] == ("gauge", "NaN")
+
+
+def test_chrome_trace_export():
+    timer = StepTimer()
+    with timer("compile"):
+        pass
+    with timer("rollout"):
+        timer.fence(jnp.ones(4) * 2)
+    doc = json.loads(timer.export_chrome_trace())
+    assert doc["displayTimeUnit"] == "ms"
+    events = doc["traceEvents"]
+    assert [e["name"] for e in events] == ["compile", "rollout"]
+    for e in events:
+        assert e["ph"] == "X"
+        assert e["ts"] >= 0 and e["dur"] >= 0
+        assert {"pid", "tid", "cat"} <= set(e)
+    # completion order with monotone start offsets
+    assert events[0]["ts"] <= events[1]["ts"]
+
+
+# ---------------------------------------------------------------------------
+# live /metrics plane
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_endpoint_roundtrip():
+    import http.client
+
+    from go_libp2p_pubsub_tpu.net import LiveNetwork
+
+    net = LiveNetwork()
+    try:
+        hosts = net.make_hosts(3)
+        topic = hosts[0].new_topic("flight")
+        subs = [h.subscribe(hosts[0].id, "flight") for h in hosts[1:]]
+        topic.publish_message(b"recorder")
+        for s in subs:
+            assert s.get(timeout=5.0) == b"recorder"
+
+        addr, port = net.serve_metrics()
+        assert net.serve_metrics() == (addr, port)  # idempotent
+
+        conn = http.client.HTTPConnection(addr, port, timeout=5)
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        body = resp.read().decode()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type").startswith("text/plain")
+        assert "version=0.0.4" in resp.getheader("Content-Type")
+        metrics = {
+            line.split(" ")[0]: float(line.split(" ")[1])
+            for line in body.splitlines()
+            if line and not line.startswith("#")
+        }
+        assert metrics["live_msgs_published_total"] >= 1
+        assert metrics["live_join_admitted_total"] >= 1
+
+        conn = http.client.HTTPConnection(addr, port, timeout=5)
+        conn.request("GET", "/debug/tree")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        tree = json.loads(resp.read())
+        assert hosts[0].id in tree
+        root_topics = tree[hosts[0].id]["topics"]
+        assert root_topics["flight"]["subtree_size"] == 3
+
+        conn = http.client.HTTPConnection(addr, port, timeout=5)
+        conn.request("GET", "/nope")
+        assert conn.getresponse().status == 404
+    finally:
+        net.shutdown()

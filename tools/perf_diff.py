@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Diff two bench records (``BENCH_r0N.json``) into a regression table.
+
+Usage::
+
+    python tools/perf_diff.py BENCH_r04.json BENCH_r05.json [--threshold 5]
+    python tools/perf_diff.py old.json new.json --strict   # rc 1 on regression
+
+Accepts either shape the repo produces: the raw JSON line ``bench.py``
+prints, or the driver's round record wrapping it under ``"parsed"``.
+
+Compares the headline (value / vs_baseline), latency percentiles (p50 and,
+when the flight record is present, the histogram-derived p50/p99), delivery
+fraction, startup budgets, the per-phase breakdown, and the ed25519 verify
+rates.  Each row knows its polarity (throughput up = better, latency/time
+down = better); moves beyond ``--threshold`` percent are flagged.
+
+Context mismatches that make absolute comparison unsound — different
+``methodology_version`` (accounting change, see PERF.md), backend, or peer
+count — are called out in the header instead of being silently averaged
+into the table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+# (json key path, label, higher_is_better)
+SCALAR_ROWS: List[Tuple[Tuple[str, ...], str, bool]] = [
+    (("value",), "headline msgs/sec", True),
+    (("vs_baseline",), "vs 1M baseline", True),
+    (("delivery_frac",), "delivery frac", True),
+    (("p50_latency_rounds",), "p50 latency (rounds)", False),
+    (("flight", "lat_p50"), "flight hist p50 (rounds)", False),
+    (("flight", "lat_p99"), "flight hist p99 (rounds)", False),
+    (("window_verify_charged_ms",), "verify charged (ms)", False),
+    (("init_s",), "init (s)", False),
+    (("compile_s",), "compile (s)", False),
+    (("ed25519_native_sigs_per_sec",), "native ed25519 sigs/s", True),
+    (("treecast_10peer_deliveries_per_sec",), "treecast deliveries/s", True),
+    (("scoring_heartbeat_ms",), "scoring heartbeat (ms)", False),
+]
+
+
+def load_record(path: str) -> Dict[str, Any]:
+    with open(path) as fh:
+        d = json.load(fh)
+    if "parsed" in d:
+        if not isinstance(d["parsed"], dict):
+            raise SystemExit(
+                f"{path}: round record has no parsed bench line "
+                f"(rc={d.get('rc')}) — that round crashed; nothing to diff"
+            )
+        d = d["parsed"]
+    if "metric" not in d:
+        raise SystemExit(f"{path}: neither a bench JSON line nor a round "
+                         f"record with a 'parsed' payload")
+    return d
+
+
+def dig(d: Dict[str, Any], path: Tuple[str, ...]) -> Optional[float]:
+    cur: Any = d
+    for k in path:
+        if not isinstance(cur, dict) or k not in cur:
+            return None
+        cur = cur[k]
+    try:
+        return float(cur)
+    except (TypeError, ValueError):
+        return None
+
+
+def classify(old: Optional[float], new: Optional[float],
+             higher_better: bool, threshold: float) -> Tuple[str, str]:
+    """(delta string, flag) for one row."""
+    if old is None or new is None:
+        return "-", "n/a"
+    if old == 0:
+        return "-", "n/a" if new == 0 else ("better" if
+                                            (new > 0) == higher_better
+                                            else "REGRESSED")
+    pct = (new - old) / abs(old) * 100.0
+    delta = f"{pct:+.1f}%"
+    improved = (pct > 0) == higher_better
+    if abs(pct) <= threshold:
+        return delta, "~"
+    return delta, ("better" if improved else "REGRESSED")
+
+
+def fmt(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return f"{v:.4g}"
+
+
+def collect_rows(old: Dict[str, Any], new: Dict[str, Any], threshold: float):
+    rows = []
+    for path, label, hib in SCALAR_ROWS:
+        o, n = dig(old, path), dig(new, path)
+        if o is None and n is None:
+            continue
+        delta, flag = classify(o, n, hib, threshold)
+        rows.append((label, fmt(o), fmt(n), delta, flag))
+    # phase breakdown: per-phase times, lower is better
+    phases = sorted(set(old.get("phase_breakdown_ms", {}))
+                    | set(new.get("phase_breakdown_ms", {})))
+    for ph in phases:
+        o = dig(old, ("phase_breakdown_ms", ph))
+        n = dig(new, ("phase_breakdown_ms", ph))
+        delta, flag = classify(o, n, False, threshold)
+        rows.append((f"phase {ph} (ms)", fmt(o), fmt(n), delta, flag))
+    # device verify scaling curve: per-batch sigs/s, higher is better
+    batches = sorted(set(old.get("ed25519_device_scaling", {}))
+                     | set(new.get("ed25519_device_scaling", {})), key=int)
+    for b in batches:
+        o = dig(old, ("ed25519_device_scaling", b))
+        n = dig(new, ("ed25519_device_scaling", b))
+        delta, flag = classify(o, n, True, threshold)
+        rows.append((f"device ed25519 @{b} (sigs/s)", fmt(o), fmt(n),
+                     delta, flag))
+    return rows
+
+
+def context_warnings(old: Dict[str, Any], new: Dict[str, Any]) -> List[str]:
+    warns = []
+    mo = old.get("methodology_version")
+    mn = new.get("methodology_version")
+    if mo != mn:
+        warns.append(
+            f"methodology_version differs (old {mo}, new {mn}): the "
+            f"accounting changed between rounds — deltas reflect the "
+            f"methodology as much as the code (see PERF.md)"
+        )
+    elif mo is None:
+        warns.append(
+            "neither record carries methodology_version (pre-r6 rounds); "
+            "check PERF.md for which verify accounting each round used"
+        )
+    for key in ("backend", "n_peers", "propagate_kernel"):
+        if old.get(key) != new.get(key):
+            warns.append(
+                f"{key} differs: {old.get(key)!r} vs {new.get(key)!r}"
+            )
+    return warns
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("old")
+    ap.add_argument("new")
+    ap.add_argument("--threshold", type=float, default=5.0,
+                    help="percent change below which a move is noise (~)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 if any row regressed beyond the threshold")
+    args = ap.parse_args(argv)
+
+    old, new = load_record(args.old), load_record(args.new)
+    print(f"old: {args.old}  ({old.get('backend', '?')}, "
+          f"{old.get('n_peers', '?')} peers)")
+    print(f"new: {args.new}  ({new.get('backend', '?')}, "
+          f"{new.get('n_peers', '?')} peers)")
+    for w in context_warnings(old, new):
+        print(f"WARNING: {w}")
+    print()
+
+    rows = collect_rows(old, new, args.threshold)
+    headers = ("metric", "old", "new", "delta", "flag")
+    widths = [max(len(headers[i]), *(len(r[i]) for r in rows))
+              for i in range(5)]
+    line = "  ".join(h.ljust(widths[i]) for i, h in enumerate(headers))
+    print(line)
+    print("  ".join("-" * w for w in widths))
+    for r in rows:
+        print("  ".join(str(c).ljust(widths[i]) for i, c in enumerate(r)))
+
+    regressed = [r[0] for r in rows if r[4] == "REGRESSED"]
+    if regressed:
+        print(f"\n{len(regressed)} regressed beyond "
+              f"{args.threshold:.1f}%: {', '.join(regressed)}")
+    return 1 if (args.strict and regressed) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
