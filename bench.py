@@ -542,6 +542,20 @@ def child_main() -> None:
     registry.observe_state("gossip", gossip_metrics(out))
     log("prometheus exposition:\n" + registry.render_prometheus())
 
+    # Scenario-verdict rider: the smallest canon campaign runs green (or
+    # the bench record says exactly which SLO broke) — the scenario suite
+    # is the behavioral regression surface next to this throughput headline
+    # (PERF.md "Scenario verdicts").  Never takes down the bench itself.
+    try:
+        from go_libp2p_pubsub_tpu import scenario
+
+        scen_res = scenario.run_scenario(scenario.build("steady_state"))
+        scenario_verdict = scen_res.verdict.to_dict()
+        log(f"scenario smoke: {scen_res.verdict}")
+    except Exception as e:  # pragma: no cover - diagnostic surface
+        scenario_verdict = {"error": f"{type(e).__name__}: {e}"}
+        log(f"scenario smoke FAILED to run: {scenario_verdict['error']}")
+
     trace_out = os.environ.get("BENCH_TRACE_OUT")
     if trace_out:
         with open(trace_out, "w") as fh:
@@ -579,6 +593,7 @@ def child_main() -> None:
                 "compile_s": round(compile_s, 1),
                 "phase_breakdown_ms": phases,
                 "flight": flight,
+                "scenario_smoke": scenario_verdict,
                 "ed25519_device_scaling": device_curve,
                 "ed25519_native_sigs_per_sec": round(native_sigs_per_sec, 1),
                 "treecast_10peer_deliveries_per_sec": round(tree_msgs_per_sec, 1),

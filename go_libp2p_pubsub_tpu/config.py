@@ -140,6 +140,22 @@ class GossipSubParams:
     #                          a one-round snapshot cannot represent d-round
     #                          notification paths, so the model
     #                          conservatively counts those duplicates
+    idontwant_wire_lag: bool = False  # IDONTWANT possession snapshot age.
+    #                          False (default, the historical behavior): a
+    #                          sender suppresses against the receiver's full
+    #                          start-of-round possession — INCLUDING first
+    #                          receipts from the immediately preceding round,
+    #                          i.e. notifications that crossed the wire with
+    #                          zero latency.  True (wire parity): snapshot
+    #                          one round older (have_w minus fresh_w, the
+    #                          previous round's first receipts) — a
+    #                          notification sent on receipt in round t-1 is
+    #                          still in flight during round t, so the
+    #                          duplicate it would have suppressed still
+    #                          crosses the wire and still counts toward P3
+    #                          mesh-delivery credit.  Receipts and scores
+    #                          are otherwise identical; only duplicate
+    #                          COUNTING moves one round later.
 
     def __post_init__(self) -> None:
         if not (self.d_lo <= self.d <= self.d_hi):

@@ -4,20 +4,20 @@ BASELINE.json config (d): "peer-scoring refresh under sybil/eclipse attack
 traces".  The v0 reference has no adversary model at all — no signing
 (``pubsub.go:117``), no validation, no scoring — so these scenarios encode
 the capability envelope: each one drives the simulator with an adversary
-schedule and records a per-step defense time series, all device-side (the
-rollout is one ``lax.scan``; metrics are reduced in-scan, not on host).
+schedule and records a per-step defense time series, all device-side.
 
-Scenarios:
-- **invalid spam** — attackers flood invalid messages (failed validation);
-  P4 penalties must evict them from every honest mesh.
-- **sybil colocation** — many attacker identities share one IP group; the
-  P6 colocation penalty must keep them un-grafted regardless of conduct.
-- **eclipse attempt** — attackers start fully occupying a target's mesh
-  slots and go silent; P3 delivery-deficit penalties must rotate them out
-  and restore the target's delivery.
+Since the scenario engine landed, every runner lowers its campaign to an
+``ops.schedule.GossipEvents`` tensor and executes it in the model's single
+``rollout_events`` scan — publishes, mutes, and attacker silence are scan
+``xs``, not host round-trips between scan segments.  The declarative form
+of the same campaigns lives in ``scenario.canon``; these runners remain
+the imperative fixtures the slow tests drive directly.
 
 Each runner returns ``(final_state, report)`` where ``report`` maps metric
-name -> per-step array (host numpy), ready for assertions or plotting.
+name -> per-step array (host numpy): the flight-recorder channels plus the
+adversary-standing series (``attacker_mesh_edges``, ``attacker_score_mean``,
+``honest_score_min``, and per-scenario extras), ready for assertions or
+plotting.
 """
 
 from __future__ import annotations
@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops import schedule as sched
 from .gossipsub import GossipState, GossipSub
 
 
@@ -69,6 +70,21 @@ def run_with_metrics(
     return st, {k: np.asarray(v) for k, v in jax.device_get(series).items()}
 
 
+def _run_events(
+    gs: GossipSub,
+    st: GossipState,
+    events,
+    attackers,
+    target=None,
+) -> Tuple[GossipState, Dict[str, np.ndarray]]:
+    """One ``rollout_events`` scan -> (final state, host-numpy report)."""
+    st, record = gs.rollout_events(
+        st, events, attackers=jnp.asarray(attackers), target=target,
+        record=True,
+    )
+    return st, {k: np.asarray(v) for k, v in jax.device_get(record).items()}
+
+
 # ---------------------------------------------------------------------------
 # scenarios
 # ---------------------------------------------------------------------------
@@ -91,33 +107,27 @@ def invalid_spam_attack(
             "the attacker set — clamping silently would model a smaller "
             "attack than reported"
         )
-    attackers = jnp.arange(gs.n) < n_attackers
+    attackers = np.arange(gs.n) < n_attackers
     rng = np.random.default_rng(seed)
-    series = []
+    n_steps = n_rounds * steps_per_round
+    events = sched.empty_gossip_events(n_steps, gs.n, n_attackers + 1)
     slot = 0
-    for _ in range(n_rounds):
+    for r in range(n_rounds):
+        t = r * steps_per_round
         # Every attacker seeds one invalid message; one honest publish too.
         for a in range(n_attackers):
-            st = gs.publish(
-                st,
-                jnp.int32(a),
-                jnp.int32(slot % gs.m),
-                jnp.asarray(False),
+            sched.add_publish(
+                events, t, {"src": a, "slot": slot % gs.m, "valid": False}
             )
             slot += 1
-        st = gs.publish(
-            st,
-            jnp.int32(int(rng.integers(n_attackers, gs.n))),
-            jnp.int32(slot % gs.m),
-            jnp.asarray(True),
+        sched.add_publish(
+            events, t,
+            {"src": int(rng.integers(n_attackers, gs.n)),
+             "slot": slot % gs.m, "valid": True},
         )
         slot += 1
-        st, s = run_with_metrics(gs, st, steps_per_round, attackers)
-        series.append(s)
-    report = {
-        k: np.concatenate([s[k] for s in series]) for k in series[0]
-    }
-    return st, report, attackers
+    st, report = _run_events(gs, st, events, attackers)
+    return st, report, jnp.asarray(attackers)
 
 
 def sybil_colocation_attack(
@@ -128,14 +138,15 @@ def sybil_colocation_attack(
 ) -> Tuple[GossipState, Dict[str, np.ndarray], jax.Array]:
     """Sybil identities (peers 0..n_sybils-1) share one colocation group;
     the P6 penalty (``ops/scoring.colocation_penalty``) is the defense."""
-    attackers = jnp.arange(gs.n) < n_sybils
+    attackers = np.arange(gs.n) < n_sybils
     group = np.asarray(st.gcounters.ip_group).copy()
     group[:n_sybils] = 0
     st = st._replace(
         gcounters=st.gcounters._replace(ip_group=jnp.asarray(group))
     )
-    st, report = run_with_metrics(gs, st, n_steps, attackers)
-    return st, report, attackers
+    events = sched.empty_gossip_events(n_steps, gs.n)
+    st, report = _run_events(gs, st, events, attackers)
+    return st, report, jnp.asarray(attackers)
 
 
 def eclipse_attempt(
@@ -156,10 +167,10 @@ def eclipse_attempt(
     Each round publishes ``msgs_per_round`` valid messages from random
     honest peers, then advances one heartbeat period with attacker relay
     suppressed on BOTH data planes: their fresh words are zeroed after
-    every step (no eager relay) AND they are marked ``gossip_mute`` (no
-    gossip service either — a mute peer advertises but never answers
-    IWANTs; every ask it attracts charges its P7 behaviour penalty).
-    Attackers stay alive and scoreable throughout.
+    every step (the schedule's ``silence`` channel — no eager relay) AND
+    they are marked ``gossip_mute`` (no gossip service either — a mute peer
+    advertises but never answers IWANTs; every ask it attracts charges its
+    P7 behaviour penalty).  Attackers stay alive and scoreable throughout.
     """
     n, k = gs.n, gs.k
     nbrs_np = np.asarray(st.nbrs)
@@ -167,52 +178,31 @@ def eclipse_attempt(
     att_ids = sorted(
         {int(nbrs_np[target, s]) for s in range(k) if mesh_np[target, s]}
     )
-    attackers = jnp.zeros((n,), bool).at[jnp.asarray(att_ids)].set(True)
+    attackers = np.zeros((n,), bool)
+    attackers[att_ids] = True
     honest_ids = np.array(
         [i for i in range(n) if i not in att_ids and i != target]
     )
-    silence = jnp.where(
-        attackers[:, None], jnp.uint32(0), jnp.uint32(0xFFFFFFFF)
-    )
+    rng = np.random.default_rng(seed)
+    n_steps = n_rounds * gs.heartbeat_steps
+    events = sched.empty_gossip_events(n_steps, n, msgs_per_round)
     # First-class promise-breaking: the heartbeat's IWANT selection skips
     # serving from muted peers and charges their P7 directly — no state
     # surgery on advertisement snapshots needed (r3 verdict item 6).
-    st = gs.set_gossip_mute(st, attackers)
-
-    def body(s, _):
-        s = gs.step(s)
-        # Attacker silence on the eager plane: drop anything they would
-        # relay next round.
-        s = s._replace(fresh_w=s.fresh_w & silence)
-        m = _attacker_metrics(gs, s, attackers)
-        # Target-centric defense metric: mesh edges to honest peers.
-        tgt_honest = (
-            s.mesh[target]
-            & s.nbr_valid[target]
-            & ~attackers[jnp.clip(s.nbrs[target], 0, n - 1)]
-        ).sum()
-        m["target_honest_mesh_edges"] = tgt_honest.astype(jnp.int32)
-        return s, m
-
-    rng = np.random.default_rng(seed)
-    series = []
+    events.mute_on[0] |= attackers
+    events.silence[:] |= attackers[None, :]
     slot = 0
-    for _ in range(n_rounds):
+    for r in range(n_rounds):
+        t = r * gs.heartbeat_steps
         for _ in range(msgs_per_round):
-            st = gs.publish(
-                st,
-                jnp.int32(int(rng.choice(honest_ids))),
-                jnp.int32(slot % gs.m),
-                jnp.asarray(True),
+            sched.add_publish(
+                events, t,
+                {"src": int(rng.choice(honest_ids)),
+                 "slot": slot % gs.m, "valid": True},
             )
             slot += 1
-        st, s = jax.lax.scan(body, st, None, length=gs.heartbeat_steps)
-        series.append(jax.device_get(s))
-    report = {
-        k_: np.concatenate([np.asarray(s[k_]) for s in series])
-        for k_ in series[0]
-    }
-    return st, report, attackers
+    st, report = _run_events(gs, st, events, attackers, target=target)
+    return st, report, jnp.asarray(attackers)
 
 
 def gossip_promise_spam_attack(
@@ -238,51 +228,29 @@ def gossip_promise_spam_attack(
     saturates possession first and nobody wants anything).
     """
     from ..config import ScoreParams
-    from ..ops import scoring as scoring_ops
 
     model_kwargs.setdefault("heartbeat_steps", 2)
     sp = model_kwargs.pop("score_params", ScoreParams())
     gs = GossipSub(n_peers=n_peers, score_params=sp, **model_kwargs)
     st = gs.init(seed=seed)
-    attackers = jnp.arange(n_peers) < n_attackers
-    st = gs.set_gossip_mute(st, attackers)
+    attackers = np.arange(n_peers) < n_attackers
     rng = np.random.default_rng(seed)
-
-    def body(s, _):
-        s = gs.step(s)
-        m = _attacker_metrics(gs, s, attackers)
-        m["attacker_behaviour_penalty"] = s.gcounters.behaviour_penalty.max(
-            where=attackers, initial=0.0
-        )
-        m["attacker_global_score"] = jnp.nanmean(
-            jnp.where(
-                attackers, scoring_ops.global_score(s.gcounters, sp), jnp.nan
-            )
-        )
-        m["honest_behaviour_penalty_max"] = jnp.where(
-            ~attackers, s.gcounters.behaviour_penalty, 0.0
-        ).max()
-        return s, m
-
-    series = []
+    n_steps = n_rounds * gs.heartbeat_steps
+    events = sched.empty_gossip_events(n_steps, n_peers, 3)
+    events.mute_on[0] |= attackers
     slot = 0
-    for _ in range(n_rounds):
+    for r in range(n_rounds):
+        t = r * gs.heartbeat_steps
         # Honest publishes only: the attack is pure gossip-service abuse.
         for _ in range(3):
-            st = gs.publish(
-                st,
-                jnp.int32(int(rng.integers(n_attackers, n_peers))),
-                jnp.int32(slot % gs.m),
-                jnp.asarray(True),
+            sched.add_publish(
+                events, t,
+                {"src": int(rng.integers(n_attackers, n_peers)),
+                 "slot": slot % gs.m, "valid": True},
             )
             slot += 1
-        st, s = jax.lax.scan(body, st, None, length=gs.heartbeat_steps)
-        series.append(jax.device_get(s))
-    report = {
-        k_: np.concatenate([np.asarray(s[k_]) for s in series])
-        for k_ in series[0]
-    }
-    return gs, st, report, attackers
+    st, report = _run_events(gs, st, events, attackers)
+    return gs, st, report, jnp.asarray(attackers)
 
 
 def backoff_spam_attack(
@@ -307,7 +275,6 @@ def backoff_spam_attack(
     ``attacker_global_score`` to the standard defense series.
     """
     from ..config import ScoreParams
-    from ..ops import scoring as scoring_ops
 
     attackers_np = np.arange(n_peers) < n_attackers
     sp = model_kwargs.pop("score_params", ScoreParams())
@@ -318,43 +285,24 @@ def backoff_spam_attack(
         **model_kwargs,
     )
     st = gs.init(seed=seed)
-    attackers = jnp.asarray(attackers_np)
     rng = np.random.default_rng(seed)
-
-    def body(s, _):
-        s = gs.step(s)
-        m = _attacker_metrics(gs, s, attackers)
-        m["attacker_behaviour_penalty"] = s.gcounters.behaviour_penalty.max(
-            where=attackers, initial=0.0
-        )
-        m["attacker_global_score"] = jnp.nanmean(
-            jnp.where(
-                attackers, scoring_ops.global_score(s.gcounters, sp), jnp.nan
-            )
-        )
-        return s, m
-
-    series = []
+    n_steps = n_rounds * gs.heartbeat_steps
+    events = sched.empty_gossip_events(n_steps, n_peers, n_attackers + 1)
     slot = 0
-    for _ in range(n_rounds):
+    for r in range(n_rounds):
+        t = r * gs.heartbeat_steps
         # Attacker spam earns the prunes; one honest publish keeps honest
         # P2 credit flowing.
         for a in range(n_attackers):
-            st = gs.publish(
-                st, jnp.int32(a), jnp.int32(slot % gs.m), jnp.asarray(False)
+            sched.add_publish(
+                events, t, {"src": a, "slot": slot % gs.m, "valid": False}
             )
             slot += 1
-        st = gs.publish(
-            st,
-            jnp.int32(int(rng.integers(n_attackers, n_peers))),
-            jnp.int32(slot % gs.m),
-            jnp.asarray(True),
+        sched.add_publish(
+            events, t,
+            {"src": int(rng.integers(n_attackers, n_peers)),
+             "slot": slot % gs.m, "valid": True},
         )
         slot += 1
-        st, s = jax.lax.scan(body, st, None, length=gs.heartbeat_steps)
-        series.append(jax.device_get(s))
-    report = {
-        k_: np.concatenate([np.asarray(s[k_]) for s in series])
-        for k_ in series[0]
-    }
-    return gs, st, report, attackers
+    st, report = _run_events(gs, st, events, attackers_np)
+    return gs, st, report, jnp.asarray(attackers_np)

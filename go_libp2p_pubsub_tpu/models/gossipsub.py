@@ -320,6 +320,34 @@ class GossipSub:
         self.use_pallas = use_pallas
         self.pallas_shard_mesh = pallas_shard_mesh
 
+    # Value semantics for the jit cache: the model is a pure function of
+    # its configuration, so two identically-configured instances may share
+    # compiled rollouts (``self`` is a static argnum everywhere).  Without
+    # this, every ``compile_scenario``/test constructing a fresh model
+    # recompiles the full scan body.  Instances carrying non-value extras
+    # (a custom topology builder, a shard mesh) fall back to identity.
+    def _config_key(self):
+        if self.builder is not None or self.pallas_shard_mesh is not None:
+            return id(self)
+        return (
+            type(self), self.n, self.k, self.m, self.conn_degree,
+            self.params, self.score_params, self.heartbeat_steps,
+            self.use_pallas, self.max_edge_delay,
+            None if self.graft_spammers is None
+            else bytes(np.asarray(self.graft_spammers)),
+            None if self.direct_edges is None
+            else bytes(np.packbits(np.asarray(self.direct_edges))),
+        )
+
+    def __eq__(self, other):
+        return (
+            type(other) is type(self)
+            and self._config_key() == other._config_key()
+        )
+
+    def __hash__(self):
+        return hash(self._config_key())
+
     def build_graph(self, seed: int = 0):
         """Connection topology only -> (nbrs, rev, nbr_valid, outbound) as
         jnp arrays.
@@ -877,6 +905,13 @@ class GossipSub:
         # rather than crediting senders with knowledge they could not have.
         idontwant = self.params.idontwant and not self.max_edge_delay
         idw = st.have_w if idontwant else None
+        if idontwant and self.params.idontwant_wire_lag:
+            # Wire-parity snapshot (idontwant_wire_lag): exclude the
+            # immediately preceding round's first receipts (fresh_w IS that
+            # set) — a notification sent on receipt in round t-1 is still
+            # crossing the wire during round t, so the sender cannot have
+            # acted on it before emitting this round's copy.
+            idw = st.have_w & ~st.fresh_w
         if self.use_pallas and self.pallas_shard_mesh is not None:
             from ..ops.pallas_gossip import propagate_packed_pallas_sharded
 
@@ -1052,6 +1087,217 @@ class GossipSub:
 
         (final, _), record_ys = jax.lax.scan(
             body, (st, hist0), None, length=n_steps
+        )
+        return final, record_ys
+
+    # -- scenario engine ----------------------------------------------------
+
+    def _apply_events(self, st: GossipState, ev) -> GossipState:
+        """Apply one step's slice of a ``GossipEvents`` schedule (scan body;
+        every branch is ``lax.cond``-gated so quiet steps pay one predicate
+        per event kind, not the event's gathers).
+
+        Order: liveness (kills+revives) -> subscription deltas -> mute
+        deltas -> delay sets -> publishes, matching the order the host API
+        calls would have been issued between scan segments.  ``silence`` is
+        NOT applied here — it acts after the step (see ``rollout_events``).
+        """
+
+        def upd_alive(s):
+            alive = (s.alive & ~ev.kill) | ev.revive
+            return s._replace(
+                alive=alive,
+                edge_live=compute_edge_live(s.nbr_valid, s.nbrs, alive),
+            )
+
+        st = jax.lax.cond(
+            ev.kill.any() | ev.revive.any(), upd_alive, lambda s: s, st
+        )
+
+        def upd_sub(s):
+            # set_subscribed's body inlined on the delta-composed mask.
+            sub = (s.subscribed & ~ev.sub_off) | ev.sub_on
+            nbr_sub = s.nbr_valid & safe_gather(sub, s.nbrs, False)
+            return s._replace(
+                subscribed=sub,
+                nbr_sub=nbr_sub,
+                mesh=s.mesh & sub[:, None] & nbr_sub,
+                fanout=s.fanout & ~sub[:, None],
+            )
+
+        st = jax.lax.cond(
+            ev.sub_off.any() | ev.sub_on.any(), upd_sub, lambda s: s, st
+        )
+        st = jax.lax.cond(
+            ev.mute_on.any() | ev.mute_off.any(),
+            lambda s: s._replace(
+                gossip_mute=(s.gossip_mute & ~ev.mute_off) | ev.mute_on
+            ),
+            lambda s: s,
+            st,
+        )
+        st = jax.lax.cond(
+            (ev.delay >= 0).any(),
+            lambda s: s._replace(
+                gossip_delay=jnp.where(ev.delay >= 0, ev.delay, s.gossip_delay)
+            ),
+            lambda s: s,
+            st,
+        )
+        # Publishes: the per-step budget P is a static shape, so this
+        # unrolls into P conditional publish graphs (keep P small — it is
+        # the busiest step's need, not the campaign total).
+        for i in range(ev.pub_src.shape[0]):
+            st = jax.lax.cond(
+                ev.pub_src[i] >= 0,
+                lambda s, j=i: self.publish(
+                    s,
+                    ev.pub_src[j],
+                    jnp.clip(ev.pub_slot[j], 0, self.m - 1),
+                    ev.pub_valid[j],
+                ),
+                lambda s: s,
+                st,
+            )
+        return st
+
+    def _campaign_record(
+        self, st: GossipState, rec, attackers, target: Optional[int]
+    ):
+        """Extend one round's flight record with adversary-standing channels
+        (the in-scan reductions the attack runners assert on)."""
+        if attackers is not None:
+            att_slot = st.nbr_valid & attackers[
+                jnp.clip(st.nbrs, 0, self.n - 1)
+            ]
+            honest = ~attackers & st.alive
+            honest_mesh = st.mesh & st.nbr_valid & honest[:, None]
+            captured = (st.mesh & att_slot & honest[:, None]).sum()
+            att_scores = jnp.where(att_slot, st.scores, jnp.nan)
+            rec["attacker_mesh_edges"] = captured.astype(jnp.int32)
+            # Mesh-capture ceiling: fraction of honest peers' mesh slots an
+            # attacker occupies — the eclipse/sybil SLO channel.
+            rec["attacker_capture_frac"] = captured / jnp.maximum(
+                honest_mesh.sum(), 1
+            )
+            rec["attacker_score_mean"] = jnp.nanmean(att_scores)
+            rec["honest_score_min"] = jnp.nanmin(
+                jnp.where(
+                    st.nbr_valid & ~att_slot & jnp.isfinite(st.scores),
+                    st.scores,
+                    jnp.nan,
+                )
+            )
+            rec["attacker_behaviour_penalty"] = (
+                st.gcounters.behaviour_penalty.max(
+                    where=attackers, initial=0.0
+                )
+            )
+            rec["attacker_global_score"] = jnp.nanmean(
+                jnp.where(
+                    attackers,
+                    scoring_ops.global_score(st.gcounters, self.score_params),
+                    jnp.nan,
+                )
+            )
+            rec["honest_behaviour_penalty_max"] = jnp.where(
+                ~attackers, st.gcounters.behaviour_penalty, 0.0
+            ).max()
+        if target is not None:
+            tgt_edges = st.mesh[target] & st.nbr_valid[target]
+            if attackers is not None:
+                tgt_edges = tgt_edges & ~attackers[
+                    jnp.clip(st.nbrs[target], 0, self.n - 1)
+                ]
+            rec["target_honest_mesh_edges"] = tgt_edges.sum().astype(jnp.int32)
+        return rec
+
+    @functools.partial(
+        jax.jit, static_argnames=("self", "record", "target")
+    )
+    def rollout_events(
+        self,
+        st: GossipState,
+        events,
+        attackers: Optional[jax.Array] = None,
+        target: Optional[int] = None,
+        record: bool = True,
+    ):
+        """Run a whole event schedule (``ops.schedule.GossipEvents``) in ONE
+        ``lax.scan`` -> (final state, flight record | None).
+
+        The device-compiled form of the host-segmented
+        ``utils.faults.run_with_faults`` / attack-runner round loops: every
+        campaign event (kill, revive, subscription churn, mute, delay,
+        publish, post-step silence) is a per-step tensor consumed as scan
+        ``xs``, so there are no host round-trips mid-campaign.  Events at
+        step t apply before round t's transition, exactly where the host
+        API calls used to land between scan segments.
+
+        With ``record=True`` the ys are ``flight_record_round`` extended by
+        the adversary channels of ``_campaign_record`` (when ``attackers``
+        / ``target`` are given); publisher self-receipts of in-scan
+        publishes are folded into the latency histogram at bin 0, keeping
+        ``delivery_frac`` exact for slot-unique campaigns.  ``silence``
+        (post-step eager-plane squelch) assumes the ideal fabric — the
+        scenario compiler rejects it when ``max_edge_delay > 0`` (the fresh
+        history would desync from fresh_w).
+        """
+        n_steps = int(events.kill.shape[0])
+
+        def silence_after(s, ev):
+            return jax.lax.cond(
+                ev.silence.any(),
+                lambda x: x._replace(
+                    fresh_w=jnp.where(
+                        ev.silence[:, None], jnp.uint32(0), x.fresh_w
+                    )
+                ),
+                lambda x: x,
+                s,
+            )
+
+        if not record:
+            def bare(s, ev):
+                s = self._apply_events(s, ev)
+                s = self.step(s)
+                return silence_after(s, ev), None
+
+            return jax.lax.scan(bare, st, events, length=n_steps)
+
+        hist0 = hist_ops.latency_histogram_seed(
+            st.first_step, st.msg_birth, st.msg_used & st.msg_valid,
+            st.alive & st.subscribed, FLIGHT_HIST_BINS,
+        )
+
+        def body(carry, ev):
+            s, hist = carry
+            s = self._apply_events(s, ev)
+            # Publisher self-receipts: an in-scan publish stamps its source
+            # at latency 0, which the per-round increment (receipts stamped
+            # by _propagate) never sees — count them here, masked the same
+            # way the histogram counts receipts (valid message, counted
+            # publisher).  Invalid publishes never enter the histogram.
+            src_c = jnp.clip(ev.pub_src, 0, self.n - 1)
+            pub_counted = (
+                (ev.pub_src >= 0)
+                & ev.pub_valid
+                & s.alive[src_c]
+                & s.subscribed[src_c]
+            ).sum(dtype=jnp.int32)
+            hist = hist.at[0].add(pub_counted)
+            s2, per_msg = self.step_recorded(s)
+            hist = hist + hist_ops.latency_histogram_increment(
+                per_msg, s2.msg_birth, s2.msg_used & s2.msg_valid,
+                s.step, FLIGHT_HIST_BINS,
+            )
+            s2 = silence_after(s2, ev)
+            rec = self.flight_record_round(s2, hist)
+            rec = self._campaign_record(s2, rec, attackers, target)
+            return (s2, hist), rec
+
+        (final, _), record_ys = jax.lax.scan(
+            body, (st, hist0), events, length=n_steps
         )
         return final, record_ys
 

@@ -164,6 +164,17 @@ class MultiTopicGossipSub:
         self.score_params = self.gs.score_params
         self.heartbeat_steps = heartbeat_steps
 
+    # Value semantics for the jit cache (see GossipSub.__eq__): the model
+    # is (n_topics, inner single-topic config).
+    def __eq__(self, other):
+        return (
+            type(other) is type(self)
+            and (self.t, self.gs) == (other.t, other.gs)
+        )
+
+    def __hash__(self):
+        return hash((type(self), self.t, self.gs))
+
     # -- construction -------------------------------------------------------
 
     def init(
@@ -567,6 +578,101 @@ class MultiTopicGossipSub:
 
         st, _ = jax.lax.scan(body, st, None, length=n_steps)
         return st
+
+    # -- scenario engine ----------------------------------------------------
+
+    def flight_record_round(self, st: MultiTopicState):
+        """One round's telemetry across all topics (device scalars + one
+        summed latency histogram).
+
+        Unlike the single-topic recorder there is no receipt tap threaded
+        through the vmapped propagate, so the histogram is RECOUNTED from
+        the stamp table each round (``latency_histogram`` vmapped over
+        topics) — an [T, N, M] pass per round that is fine at scenario
+        scale and deliberately not the 100k-peer bench path.
+        """
+        from .gossipsub import FLIGHT_HIST_BINS
+        from ..ops import histogram as hist_ops
+
+        topic_alive = self._topic_alive(st)                   # [T, N]
+        in_window = st.msg_used & st.msg_valid                # [T, M]
+        hist = jax.vmap(
+            hist_ops.latency_histogram, (0, 0, 0, 0, None)
+        )(
+            st.first_step, st.msg_birth, in_window, topic_alive,
+            FLIGHT_HIST_BINS,
+        ).sum(axis=0)
+        expected = (
+            topic_alive.sum(axis=1) * in_window.sum(axis=1)
+        ).sum()
+        mesh_deg = (st.mesh & st.nbr_valid[None]).sum(axis=2)  # [T, N]
+        part_total = jnp.maximum(topic_alive.sum(), 1)
+        return {
+            "step": st.step,
+            "peers_alive": st.alive.sum(),
+            "delivery_frac": hist.sum() / jnp.maximum(expected, 1),
+            "mesh_degree_mean": jnp.where(topic_alive, mesh_deg, 0).sum()
+            / part_total,
+            "gossip_pending": bitpack.popcount(st.gossip_pend_w).sum(),
+            "lat_hist": hist,
+        }
+
+    @functools.partial(jax.jit, static_argnames=("self", "record"))
+    def rollout_events(self, st: MultiTopicState, events, record: bool = True):
+        """Run a whole event schedule (``ops.schedule.MultiTopicEvents``) in
+        ONE ``lax.scan`` -> (final state, flight record | None); the
+        multi-topic twin of ``GossipSub.rollout_events`` (kills, mute and
+        delay windows, topic-stamped publishes)."""
+        n_steps = int(events.kill.shape[0])
+
+        def body(s, ev):
+            s = jax.lax.cond(
+                ev.kill.any(),
+                lambda x: x._replace(
+                    alive=x.alive & ~ev.kill,
+                    edge_live=jax.vmap(compute_edge_live, (None, None, 0))(
+                        x.nbr_valid, x.nbrs,
+                        (x.alive & ~ev.kill)[None, :] & x.subscribed,
+                    ),
+                ),
+                lambda x: x,
+                s,
+            )
+            s = jax.lax.cond(
+                ev.mute_on.any() | ev.mute_off.any(),
+                lambda x: x._replace(
+                    gossip_mute=(x.gossip_mute & ~ev.mute_off) | ev.mute_on
+                ),
+                lambda x: x,
+                s,
+            )
+            s = jax.lax.cond(
+                (ev.delay >= 0).any(),
+                lambda x: x._replace(
+                    gossip_delay=jnp.where(
+                        ev.delay >= 0, ev.delay, x.gossip_delay
+                    )
+                ),
+                lambda x: x,
+                s,
+            )
+            for i in range(ev.pub_src.shape[0]):
+                s = jax.lax.cond(
+                    (ev.pub_src[i] >= 0) & (ev.pub_topic[i] >= 0),
+                    lambda x, j=i: self.publish(
+                        x,
+                        jnp.clip(ev.pub_topic[j], 0, self.t - 1),
+                        ev.pub_src[j],
+                        jnp.clip(ev.pub_slot[j], 0, self.m - 1),
+                        ev.pub_valid[j],
+                    ),
+                    lambda x: x,
+                    s,
+                )
+            s = self.step(s)
+            return s, (self.flight_record_round(s) if record else None)
+
+        return jax.lax.scan(body, st, events, length=n_steps)
 
     # -- views / metrics ----------------------------------------------------
 

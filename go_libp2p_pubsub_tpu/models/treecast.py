@@ -26,6 +26,18 @@ class TreeCast:
         self.params = params or SimParams()
         self.opts = opts or TreeOpts()
 
+    # Value semantics so identically-configured instances share the jit
+    # cache (``self`` is static in the rollouts; the model is a pure
+    # function of its two frozen param sets).
+    def __eq__(self, other):
+        return (
+            type(other) is type(self)
+            and (self.params, self.opts) == (other.params, other.opts)
+        )
+
+    def __hash__(self):
+        return hash((type(self), self.params, self.opts))
+
     def init(self, root: int = 0) -> TreeState:
         return tree_ops.init_state(self.params, self.opts, root=root)
 
@@ -52,6 +64,51 @@ class TreeCast:
             return s, (tree_metrics(s) if record else None)
 
         return jax.lax.scan(body, state, None, length=n_steps)
+
+    @functools.partial(jax.jit, static_argnames=("self", "record"))
+    def rollout_events(self, state: TreeState, events, record: bool = True):
+        """Run a whole event schedule (``ops.schedule.TreeEvents``) in ONE
+        ``lax.scan`` -> (final state, flight record | None).
+
+        The tree plane's twin of ``GossipSub.rollout_events``: kills,
+        graceful leaves, join walks, and root publishes are per-step
+        tensors consumed as scan ``xs`` — the device-compiled form of the
+        host-segmented ``utils.faults.run_with_faults`` driving.  Events at
+        step t apply before round t's transition.
+        """
+        from ..utils.metrics import tree_metrics
+
+        n_steps = int(events.kill.shape[0])
+
+        def body(s, ev):
+            s = jax.lax.cond(
+                ev.kill.any(),
+                lambda x: x._replace(alive=x.alive & ~ev.kill),
+                lambda x: x,
+                s,
+            )
+            s = jax.lax.cond(
+                ev.leave.any(),
+                lambda x: x._replace(leaving=x.leaving | ev.leave),
+                lambda x: x,
+                s,
+            )
+            s = jax.lax.cond(
+                ev.sub.any(),
+                lambda x: tree_ops.begin_subscribe_many(x, ev.sub),
+                lambda x: x,
+                s,
+            )
+            s = jax.lax.cond(
+                (ev.pub_msg >= 0).any(),
+                lambda x: tree_ops.publish_many(x, ev.pub_msg),
+                lambda x: x,
+                s,
+            )
+            s = tree_ops.step(s)
+            return s, (tree_metrics(s) if record else None)
+
+        return jax.lax.scan(body, state, events, length=n_steps)
 
     def build_demo_state(self, n_peers: int, n_msgs: int = 4) -> TreeState:
         """A small joined tree with queued traffic, for compile checks/bench.

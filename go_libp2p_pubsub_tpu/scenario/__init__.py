@@ -1,0 +1,51 @@
+"""Scenario engine: declarative, device-compiled adversity campaigns.
+
+- :mod:`.spec` — the declarative layer (:class:`ScenarioSpec` + JSON).
+- :mod:`.compiler` — lowering to ``ops.schedule`` event tensors.
+- :mod:`.runner` — execution, traces, bit-for-bit replay.
+- :mod:`.slo` — verdicts graded from the flight record.
+- :mod:`.canon` — the named, committed campaign suite.
+"""
+
+from .canon import CANON, build, build_all
+from .compiler import CompiledScenario, compile_scenario
+from .runner import (
+    ScenarioResult,
+    replay_trace,
+    run_scenario,
+    run_suite,
+    save_trace,
+    trace_document,
+)
+from .slo import Criterion, Verdict, evaluate
+from .spec import (
+    SLO,
+    AttackWave,
+    ChurnPhase,
+    LinkWindow,
+    ScenarioSpec,
+    Workload,
+)
+
+__all__ = [
+    "CANON",
+    "AttackWave",
+    "ChurnPhase",
+    "CompiledScenario",
+    "Criterion",
+    "LinkWindow",
+    "SLO",
+    "ScenarioResult",
+    "ScenarioSpec",
+    "Verdict",
+    "Workload",
+    "build",
+    "build_all",
+    "compile_scenario",
+    "evaluate",
+    "replay_trace",
+    "run_scenario",
+    "run_suite",
+    "save_trace",
+    "trace_document",
+]

@@ -1,0 +1,241 @@
+"""The canon: named, committed adversity campaigns with SLO thresholds.
+
+These are the regression surface PERF.md points at — each returns a fresh
+:class:`~.spec.ScenarioSpec` (specs are cheap data; mutate your copy
+freely).  Sizes are chosen to run the whole suite on a laptop CPU in tens
+of seconds; the defense parameterizations mirror the known-good settings
+the slow attack tests converged on, so a canon verdict flipping red means
+the protocol moved, not the scenario.
+
+``CANON`` maps name -> builder; ``build(name)`` / ``build_all()`` resolve.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+from .spec import SLO, AttackWave, ChurnPhase, LinkWindow, ScenarioSpec, Workload
+
+_MESH_64 = dict(n_peers=64, n_slots=16, conn_degree=8, msg_window=64,
+                heartbeat_steps=4)
+
+
+def steady_state() -> ScenarioSpec:
+    """Healthy mesh, constant publish load, no adversity — the floor every
+    other verdict is read against."""
+    return ScenarioSpec(
+        name="steady_state",
+        family="gossipsub",
+        n_steps=24,
+        seed=7,
+        model=dict(_MESH_64),
+        workloads=[Workload(kind="constant", start=2, stop=20, every=2)],
+        slo=SLO(min_delivery_frac=0.97, max_p50=2.0, max_p99=6.0),
+        description="64-peer mesh, one publish every 2 rounds, no faults.",
+    )
+
+
+def flash_crowd() -> ScenarioSpec:
+    """A burst of simultaneous publishes from distinct random peers — the
+    flood_publish/fanout hot path under contention."""
+    return ScenarioSpec(
+        name="flash_crowd",
+        family="gossipsub",
+        n_steps=24,
+        seed=11,
+        model=dict(_MESH_64),
+        workloads=[Workload(kind="burst", start=4, n_msgs=12)],
+        slo=SLO(min_delivery_frac=0.97, max_p99=8.0),
+        description="12 messages published in the same round.",
+    )
+
+
+def churn_10pct() -> ScenarioSpec:
+    """~10% of the mesh abruptly killed across the run while traffic keeps
+    flowing; deliveries must hold for the survivors."""
+    return ScenarioSpec(
+        name="churn_10pct",
+        family="gossipsub",
+        n_steps=40,
+        seed=13,
+        model=dict(_MESH_64),
+        workloads=[Workload(kind="constant", start=2, stop=34, every=2)],
+        churn=[ChurnPhase(start=6, stop=30, every=4, kills_per_event=1)],
+        slo=SLO(min_delivery_frac=0.90, max_p99=10.0),
+        description="6 abrupt kills (about 10% of 64) under constant load.",
+    )
+
+
+def partition_heal() -> ScenarioSpec:
+    """A block of peers drops at once and revives 8 rounds later; gossip
+    (IHAVE within the mcache window) must backfill what they missed."""
+    return ScenarioSpec(
+        name="partition_heal",
+        family="gossipsub",
+        n_steps=48,
+        seed=17,
+        model=dict(_MESH_64, params={"history_gossip": 3}),
+        workloads=[Workload(kind="constant", start=2, stop=40, every=2)],
+        churn=[ChurnPhase(
+            start=12, stop=13, every=1, kills_per_event=10, rejoin_after=8,
+        )],
+        slo=SLO(min_delivery_frac=0.85),
+        description="10 peers partitioned for 8 rounds, then healed.",
+    )
+
+
+def sybil_colocation() -> ScenarioSpec:
+    """Sybils behind one IP try to saturate honest meshes; the P6
+    colocation penalty must cap their capture."""
+    return ScenarioSpec(
+        name="sybil_colocation",
+        family="gossipsub",
+        n_steps=48,
+        seed=19,
+        model=dict(
+            n_peers=96, n_slots=16, conn_degree=8, msg_window=32,
+            heartbeat_steps=4,
+            score_params={
+                "ip_colocation_factor_weight": -1.0,
+                "ip_colocation_factor_threshold": 1.0,
+            },
+        ),
+        workloads=[Workload(kind="constant", start=2, stop=32, every=4)],
+        attacks=[AttackWave(kind="sybil", n_attackers=12)],
+        # The 12 penalized sybils (12.5% of peers) score below the gossip
+        # threshold and stop receiving — delivery_frac ~0.88 IS the defense
+        # working; the floor guards the honest 87.5%.
+        slo=SLO(min_delivery_frac=0.85, max_capture_frac=0.30),
+        description="12 colocated sybils vs the P6 defense.",
+    )
+
+
+def eclipse_backoff_spam() -> ScenarioSpec:
+    """The target's whole converged mesh turns adversarial (receive, never
+    relay) AND graft-spams through prune backoff; scoring must re-open
+    honest mesh slots for the target."""
+    return ScenarioSpec(
+        name="eclipse_backoff_spam",
+        family="gossipsub",
+        n_steps=48,
+        seed=23,
+        model=dict(
+            n_peers=96, n_slots=32, conn_degree=20, msg_window=32,
+            heartbeat_steps=4,
+            score_params={
+                "mesh_message_deliveries_weight": -1.0,
+                "mesh_message_deliveries_threshold": 1.5,
+                "mesh_message_deliveries_activation_s": 3.0,
+            },
+        ),
+        workloads=[Workload(kind="constant", start=2, stop=40, every=2)],
+        attacks=[AttackWave(
+            kind="eclipse", target=5, start=4, graft_spam=True,
+        )],
+        slo=SLO(min_final_target_honest_edges=1),
+        description="Eclipse of peer 5 with backoff graft spam.",
+    )
+
+
+def spam_flood() -> ScenarioSpec:
+    """Invalid-message flood; P4 must bury the spammers' scores while
+    honest delivery holds."""
+    return ScenarioSpec(
+        name="spam_flood",
+        family="gossipsub",
+        n_steps=40,
+        seed=29,
+        model=dict(
+            n_peers=96, n_slots=16, conn_degree=8, msg_window=64,
+            heartbeat_steps=4,
+            score_params={"invalid_message_deliveries_weight": -30.0},
+        ),
+        workloads=[Workload(kind="constant", start=2, stop=32, every=4)],
+        attacks=[AttackWave(
+            kind="spam", n_attackers=4, start=4, stop=24, spam_every=4,
+        )],
+        slo=SLO(min_delivery_frac=0.90),
+        description="4 spammers, one invalid publish each every 4 rounds.",
+    )
+
+
+def degraded_links() -> ScenarioSpec:
+    """A quarter of the mesh behind slow ingress links for a window —
+    deliveries hold, the latency tail pays."""
+    return ScenarioSpec(
+        name="degraded_links",
+        family="gossipsub",
+        n_steps=32,
+        seed=31,
+        model=dict(_MESH_64),
+        workloads=[Workload(kind="constant", start=2, stop=28, every=2)],
+        links=[LinkWindow(start=6, stop=22, delay=2, frac=0.25)],
+        slo=SLO(min_delivery_frac=0.95),
+        description="25% of peers at +2 rounds ingress delay for 16 rounds.",
+    )
+
+
+def tree_churn_heal() -> ScenarioSpec:
+    """TreeCast under leave/kill churn with rejoin: the repair walk must
+    re-attach everyone and drain the root's queue."""
+    return ScenarioSpec(
+        name="tree_churn_heal",
+        family="treecast",
+        n_steps=64,
+        seed=37,
+        model=dict(max_peers=32, n_peers=24),
+        workloads=[Workload(kind="constant", start=4, stop=48, every=8)],
+        churn=[
+            ChurnPhase(start=8, stop=32, every=8, kills_per_event=1,
+                       graceful=True, rejoin_after=12),
+            ChurnPhase(start=12, stop=36, every=12, kills_per_event=1,
+                       rejoin_after=16),
+        ],
+        slo=SLO(max_final_orphans=0, min_delivered_total=1),
+        description="Graceful leaves + abrupt kills with rejoin on a tree.",
+    )
+
+
+def multitopic_hot_publisher() -> ScenarioSpec:
+    """One hot publisher per topic across a shared mesh fabric."""
+    return ScenarioSpec(
+        name="multitopic_hot_publisher",
+        family="multitopic",
+        n_steps=24,
+        seed=41,
+        model=dict(n_topics=2, n_peers=64, n_slots=16, conn_degree=8,
+                   msg_window=64, heartbeat_steps=4),
+        workloads=[
+            Workload(kind="hot", src=3, topic=0, start=2, stop=20, every=2),
+            Workload(kind="hot", src=9, topic=1, start=3, stop=20, every=2),
+        ],
+        slo=SLO(min_delivery_frac=0.90),
+        description="Two topics, one pinned publisher each.",
+    )
+
+
+CANON: Dict[str, Callable[[], ScenarioSpec]] = {
+    "steady_state": steady_state,
+    "flash_crowd": flash_crowd,
+    "churn_10pct": churn_10pct,
+    "partition_heal": partition_heal,
+    "sybil_colocation": sybil_colocation,
+    "eclipse_backoff_spam": eclipse_backoff_spam,
+    "spam_flood": spam_flood,
+    "degraded_links": degraded_links,
+    "tree_churn_heal": tree_churn_heal,
+    "multitopic_hot_publisher": multitopic_hot_publisher,
+}
+
+
+def build(name: str) -> ScenarioSpec:
+    try:
+        return CANON[name]()
+    except KeyError:
+        raise KeyError(
+            f"unknown canon scenario {name!r}; have: {', '.join(CANON)}"
+        ) from None
+
+
+def build_all(names: List[str] | None = None) -> List[ScenarioSpec]:
+    return [build(n) for n in (names or list(CANON))]

@@ -1,0 +1,503 @@
+"""Scenario compiler: lower a :class:`ScenarioSpec` to device event tensors.
+
+The lowering is a pure host-side function of the spec: every random draw
+(churn victims, workload publishers, link cohorts) comes from a
+``np.random.default_rng([seed, tag, index])`` substream, so the same spec
+always produces the same event tensors — the foundation of bit-for-bit
+replay.  The output is a :class:`CompiledScenario`: the constructed model,
+its initialized (and possibly adversary-prepared) state, and one
+``ops.schedule`` event NamedTuple whose leading axis is the scan axis of
+the model's ``rollout_events`` — the campaign executes in a single
+``lax.scan`` with no host round-trips.
+
+Model-family support matrix (unsupported combinations raise at compile
+time rather than silently dropping events):
+
+==============  =========  ========  ==========
+event           gossipsub  treecast  multitopic
+==============  =========  ========  ==========
+abrupt churn        x         x          x
+graceful churn      x         x
+rejoin              x         x          x
+attack waves        x                spam kinds
+link windows        x                    x
+workloads           x       (root)       x
+==============  =========  ========  ==========
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..config import GossipSubParams, ScoreParams, SimParams, TreeOpts
+from ..ops import schedule as sched
+from .spec import ScenarioSpec
+
+# Substream tags: each spec component draws from its own child stream, so
+# adding/removing one component never shifts another's randomness.
+_TAG_WORKLOAD, _TAG_CHURN, _TAG_LINK, _TAG_ATTACK = 1, 2, 3, 4
+
+
+@dataclasses.dataclass
+class CompiledScenario:
+    """A spec lowered against a concrete model + initialized state."""
+
+    spec: ScenarioSpec
+    model: Any
+    state: Any
+    events: Any                       # ops.schedule.*Events (host numpy)
+    attackers: Optional[np.ndarray]   # bool[N] union of wave attackers
+    target: Optional[int]             # eclipse target (record channel)
+    n_publishes: int
+
+
+def _rng(seed: int, tag: int, index: int) -> np.random.Generator:
+    return np.random.default_rng([seed, tag, index])
+
+
+def _split_model_kwargs(spec: ScenarioSpec) -> Dict[str, Any]:
+    kw = dict(spec.model)
+    if "params" in kw:
+        kw["params"] = GossipSubParams(**kw["params"])
+    if "score_params" in kw:
+        kw["score_params"] = ScoreParams(**kw["score_params"])
+    return kw
+
+
+def build_model(spec: ScenarioSpec, graft_spammers=None):
+    """Construct the spec's model (host side; no state yet)."""
+    if spec.family == "gossipsub":
+        from ..models.gossipsub import GossipSub
+
+        kw = _split_model_kwargs(spec)
+        return GossipSub(use_pallas=False, graft_spammers=graft_spammers, **kw)
+    if spec.family == "multitopic":
+        from ..models.multitopic import MultiTopicGossipSub
+
+        if graft_spammers is not None:
+            raise ValueError("graft_spam waves are gossipsub-only")
+        return MultiTopicGossipSub(**_split_model_kwargs(spec))
+    # treecast: model kwargs split into SimParams / TreeOpts fields.
+    from ..models.treecast import TreeCast
+
+    kw = dict(spec.model)
+    kw.pop("n_peers", None)
+    sim_names = {f.name for f in dataclasses.fields(SimParams)}
+    opt_names = {f.name for f in dataclasses.fields(TreeOpts)}
+    sim_kw = {k: v for k, v in kw.items() if k in sim_names}
+    opt_kw = {k: v for k, v in kw.items() if k in opt_names}
+    unknown = set(kw) - sim_names - opt_names
+    if unknown:
+        raise ValueError(f"unknown treecast model keys: {sorted(unknown)}")
+    return TreeCast(params=SimParams(**sim_kw), opts=TreeOpts(**opt_kw))
+
+
+def _init_tree_state(model, spec: ScenarioSpec):
+    """A fully joined tree of ``n_peers`` (batched join walk, host loop)."""
+    import jax.numpy as jnp
+
+    from ..ops import tree as tree_ops
+
+    n_peers = spec.model.get("n_peers", model.params.max_peers)
+    if n_peers > model.params.max_peers:
+        raise ValueError("n_peers exceeds max_peers")
+    st = model.init(root=0)
+    mask = np.zeros(model.params.max_peers, bool)
+    mask[:n_peers] = True
+    st = tree_ops.begin_subscribe_many(st, jnp.asarray(mask))
+    for _ in range(8 * n_peers):
+        if bool(np.asarray(st.joined[:n_peers]).all()):
+            break
+        st = tree_ops.step(st)
+    else:
+        raise RuntimeError("tree join walk did not converge")
+    return st
+
+
+def _eclipse_wave(spec: ScenarioSpec):
+    waves = [a for a in spec.attacks if a.kind == "eclipse"]
+    if len(waves) > 1:
+        raise ValueError("at most one eclipse wave per scenario")
+    return waves[0] if waves else None
+
+
+def _window(start: int, stop: Optional[int], n_steps: int) -> Tuple[int, int]:
+    stop = n_steps if stop is None else min(stop, n_steps)
+    if not (0 <= start < n_steps) or stop <= start:
+        raise ValueError(
+            f"event window [{start}, {stop}) outside scenario [0, {n_steps})"
+        )
+    return start, stop
+
+
+def compile_scenario(spec: ScenarioSpec) -> CompiledScenario:
+    """Lower ``spec`` -> (model, initialized state, event tensors)."""
+    if spec.family == "treecast":
+        return _compile_tree(spec)
+    return _compile_gossip_like(spec)
+
+
+# ---------------------------------------------------------------------------
+# gossipsub / multitopic lowering
+# ---------------------------------------------------------------------------
+
+def _compile_gossip_like(spec: ScenarioSpec) -> CompiledScenario:
+    import jax.numpy as jnp
+
+    T, multitopic = spec.n_steps, spec.family == "multitopic"
+
+    # -- model + state (eclipse needs the converged mesh, so init first;
+    #    graft_spam rebinds the constructor and re-inits with the same seed,
+    #    which reproduces the same topology and warmup mesh).
+    model = build_model(spec)
+    st = model.init(seed=spec.seed)
+    n = model.n
+    ecl = _eclipse_wave(spec)
+    target = ecl.target if ecl else None
+
+    attackers = np.zeros(n, bool)
+    for w in spec.attacks:
+        if w.kind == "eclipse":
+            if multitopic:
+                raise ValueError("eclipse waves are gossipsub-only")
+            nbrs = np.asarray(st.nbrs)
+            mesh = np.asarray(st.mesh)
+            if not (0 <= w.target < n):
+                raise ValueError(f"eclipse target {w.target} out of range")
+            att_ids = sorted(
+                {int(nbrs[w.target, s]) for s in range(model.k)
+                 if mesh[w.target, s]}
+            )
+            if not att_ids:
+                raise ValueError("eclipse target has an empty mesh at init")
+            attackers[att_ids] = True
+        else:
+            if w.kind == "graft_spam" and multitopic:
+                raise ValueError("graft_spam waves are gossipsub-only")
+            attackers[: w.n_attackers] = True
+
+    if any(w.graft_spam or w.kind == "graft_spam" for w in spec.attacks):
+        model = build_model(spec, graft_spammers=attackers)
+        st = model.init(seed=spec.seed)
+
+    # Sybil colocation: attacker identities share one IP group (applied to
+    # the state once — P6 scores it from the next heartbeat on).
+    if any(w.kind == "sybil" for w in spec.attacks):
+        group = np.asarray(st.gcounters.ip_group).copy()
+        group[attackers] = int(group.min(initial=0))
+        st = st._replace(
+            gcounters=st.gcounters._replace(ip_group=jnp.asarray(group))
+        )
+
+    # -- publish requests per step (src resolution deferred to the timeline
+    #    walk so publishers are drawn from peers alive at that step).
+    # request = (picker_rng | None, src | None, valid, topic)
+    requests: List[List[tuple]] = [[] for _ in range(T)]
+    for wi, w in enumerate(spec.workloads):
+        start, stop = _window(w.start, w.stop, T)
+        rng = _rng(spec.seed, _TAG_WORKLOAD, wi)
+        if w.kind == "burst":
+            steps = [start]
+        else:
+            steps = range(start, stop, w.every)
+        for t in steps:
+            for _ in range(w.n_msgs):
+                requests[t].append((rng, w.src, w.valid, w.topic))
+    for ai, w in enumerate(spec.attacks):
+        if w.spam_every or w.kind == "spam":
+            every = w.spam_every if w.spam_every else 1
+            start, stop = _window(w.start, w.stop, T)
+            att_ids = np.flatnonzero(attackers)
+            for t in range(start, stop, every):
+                for a in att_ids:
+                    requests[t].append((None, int(a), False, 0))
+
+    n_publishes = sum(len(r) for r in requests)
+    if n_publishes > model.m:
+        raise ValueError(
+            f"scenario publishes {n_publishes} messages but the window "
+            f"holds {model.m}; grow msg_window (slot recycling would make "
+            f"the flight recorder's delivery fraction unaccountable)"
+        )
+    pub_width = max(1, max((len(r) for r in requests), default=0))
+
+    if multitopic:
+        events = sched.empty_multitopic_events(T, n, pub_width)
+    else:
+        events = sched.empty_gossip_events(T, n, pub_width)
+
+    # -- attack windows -> mute / silence tensors.
+    for w in spec.attacks:
+        if w.kind in ("eclipse", "promise_spam"):
+            start, stop = _window(w.start, w.stop, T)
+            events.mute_on[start] |= attackers
+            if stop < T:
+                events.mute_off[stop] |= attackers
+            if w.kind == "eclipse":
+                events.silence[start:stop] |= attackers[None, :]
+
+    if not multitopic and events.silence.any() and model.max_edge_delay:
+        raise ValueError(
+            "eclipse silence requires the ideal eager fabric "
+            "(max_edge_delay == 0): squelching fresh_w would desync the "
+            "per-edge fresh history"
+        )
+
+    # -- link-degradation windows -> delay set/restore rows.
+    for li, w in enumerate(spec.links):
+        start, stop = _window(w.start, w.stop, T)
+        if w.peers is not None:
+            cohort = np.asarray(w.peers, int)
+            if cohort.size and (cohort.min() < 0 or cohort.max() >= n):
+                raise ValueError(f"link window peers out of range [0, {n})")
+        else:
+            rng = _rng(spec.seed, _TAG_LINK, li)
+            size = max(1, int(round(w.frac * n)))
+            cohort = rng.choice(n, size=size, replace=False)
+        row = events.delay[start].copy()
+        row[cohort] = w.delay
+        events.delay[start] = row
+        if stop < T:
+            row = events.delay[stop].copy()
+            row[cohort] = np.where(events.delay[stop][cohort] < 0, 0,
+                                   events.delay[stop][cohort])
+            events.delay[stop] = row
+
+    # -- timeline walk: churn + faults + publish src resolution, against a
+    #    host mirror of liveness/subscription so victims and publishers are
+    #    always drawn from peers actually present at that step.
+    alive = np.ones(n, bool)
+    subscribed = np.asarray(st.subscribed).copy() if not multitopic else (
+        np.asarray(st.subscribed).any(axis=0)
+    )
+    protected = attackers.copy()
+    if target is not None:
+        protected[target] = True
+    protected[0] = True  # keep a stable publisher/root candidate
+
+    churn_events: List[List[tuple]] = [[] for _ in range(T)]  # (phase, kind)
+    for ci, ph in enumerate(spec.churn):
+        start, stop = _window(ph.start, ph.stop, T)
+        if ph.graceful and multitopic:
+            raise ValueError("graceful churn is not lowered for multitopic")
+        for t in range(start, stop, ph.every):
+            churn_events[t].append(("phase", ci))
+    if spec.faults:
+        for t_str, ids in spec.faults.get("kills", {}).items():
+            t = int(t_str)
+            if 0 <= t < T:
+                churn_events[t].append(("fault_kill", ids))
+        for t_str, ids in spec.faults.get("leaves", {}).items():
+            t = int(t_str)
+            if 0 <= t < T:
+                churn_events[t].append(("fault_leave", ids))
+            if multitopic:
+                raise ValueError("fault leaves are not lowered for multitopic")
+
+    churn_rngs = [
+        _rng(spec.seed, _TAG_CHURN, ci) for ci in range(len(spec.churn))
+    ]
+    churn_cursor = [0] * len(spec.churn)  # cycle index into explicit peers
+    rejoin_at: List[List[tuple]] = [[] for _ in range(T + 1)]  # (ids, graceful)
+    slot = 0
+
+    for t in range(T):
+        # rejoins land before this step's new departures.
+        for ids, graceful in rejoin_at[t]:
+            ids = [i for i in ids if not alive[i] or not subscribed[i]]
+            if not ids:
+                continue
+            if graceful:
+                events.sub_on[t][ids] = True
+                subscribed[ids] = True
+            else:
+                if multitopic:
+                    raise ValueError(
+                        "rejoin is not lowered for multitopic (no revive "
+                        "event tensor)"
+                    )
+                events.revive[t][ids] = True
+                alive[ids] = True
+        for kind, payload in churn_events[t]:
+            if kind == "phase":
+                ci = payload
+                ph = spec.churn[ci]
+                if ph.peers is not None:
+                    k0 = churn_cursor[ci]
+                    victims = [
+                        p for p in ph.peers[k0 : k0 + ph.kills_per_event]
+                        if 0 <= p < n
+                    ]
+                    churn_cursor[ci] = k0 + ph.kills_per_event
+                else:
+                    pool = np.flatnonzero(alive & subscribed & ~protected)
+                    take = min(ph.kills_per_event, len(pool))
+                    victims = (
+                        churn_rngs[ci].choice(pool, size=take, replace=False)
+                        .tolist() if take else []
+                    )
+                if not victims:
+                    continue
+                if ph.graceful:
+                    events.sub_off[t][victims] = True
+                    subscribed[victims] = False
+                else:
+                    events.kill[t][victims] = True
+                    alive[victims] = False
+                if ph.rejoin_after is not None:
+                    back = t + ph.rejoin_after
+                    if back <= T - 1:
+                        rejoin_at[back].append((victims, ph.graceful))
+            elif kind == "fault_kill":
+                ids = [i for i in payload if 0 <= i < n]
+                events.kill[t][ids] = True
+                alive[ids] = False
+            else:  # fault_leave -> graceful semantics (unsubscribe)
+                ids = [i for i in payload if 0 <= i < n]
+                events.sub_off[t][ids] = True
+                subscribed[ids] = False
+        for rng, src, valid, topic in requests[t]:
+            if src is None:
+                pool = np.flatnonzero(alive & subscribed & ~attackers)
+                if len(pool) == 0:
+                    raise ValueError(
+                        f"no eligible publisher alive at step {t}"
+                    )
+                src = int(rng.choice(pool))
+            elif not (0 <= src < n):
+                raise ValueError(f"publisher {src} out of range [0, {n})")
+            entry = {"src": src, "slot": slot, "valid": bool(valid)}
+            if multitopic:
+                if not (0 <= topic < model.t):
+                    raise ValueError(f"topic {topic} out of range")
+                entry["topic"] = topic
+            sched.add_publish(events, t, entry)
+            slot += 1
+
+    return CompiledScenario(
+        spec=spec, model=model, state=st, events=events,
+        attackers=attackers if attackers.any() else None,
+        target=target, n_publishes=n_publishes,
+    )
+
+
+# ---------------------------------------------------------------------------
+# treecast lowering
+# ---------------------------------------------------------------------------
+
+def _compile_tree(spec: ScenarioSpec) -> CompiledScenario:
+    T = spec.n_steps
+    if spec.attacks:
+        raise ValueError("attack waves are not lowered for treecast")
+    if spec.links:
+        raise ValueError("link windows are not lowered for treecast "
+                         "(use set_link_profile on the state)")
+    slo = spec.slo
+    if any(v is not None for v in (
+        slo.max_p50, slo.max_p99, slo.max_capture_frac,
+        slo.max_final_attacker_mesh_edges, slo.min_final_target_honest_edges,
+    )):
+        raise ValueError(
+            "latency/capture SLOs need the mesh flight recorder; the tree "
+            "record grades delivery totals and orphan backlog"
+        )
+
+    model = build_model(spec)
+    st = _init_tree_state(model, spec)
+    n = model.params.max_peers
+    n_peers = spec.model.get("n_peers", n)
+
+    requests: List[int] = [0] * T
+    for wi, w in enumerate(spec.workloads):
+        start, stop = _window(w.start, w.stop, T)
+        steps = [start] if w.kind == "burst" else range(start, stop, w.every)
+        for t in steps:
+            requests[t] += w.n_msgs
+    n_publishes = sum(requests)
+    if n_publishes > model.params.queue_cap:
+        raise ValueError(
+            f"{n_publishes} root publishes exceed queue_cap "
+            f"{model.params.queue_cap}"
+        )
+    pub_width = max(1, max(requests, default=0))
+    events = sched.empty_tree_events(T, n, pub_width)
+
+    alive = np.zeros(n, bool)
+    alive[:n_peers] = True
+    protected = np.zeros(n, bool)
+    protected[0] = True  # the root
+
+    churn_events: List[List[tuple]] = [[] for _ in range(T)]
+    for ci, ph in enumerate(spec.churn):
+        start, stop = _window(ph.start, ph.stop, T)
+        for t in range(start, stop, ph.every):
+            churn_events[t].append(("phase", ci))
+    if spec.faults:
+        for t_str, ids in spec.faults.get("kills", {}).items():
+            t = int(t_str)
+            if 0 <= t < T:
+                churn_events[t].append(("fault_kill", ids))
+        for t_str, ids in spec.faults.get("leaves", {}).items():
+            t = int(t_str)
+            if 0 <= t < T:
+                churn_events[t].append(("fault_leave", ids))
+
+    churn_rngs = [
+        _rng(spec.seed, _TAG_CHURN, ci) for ci in range(len(spec.churn))
+    ]
+    churn_cursor = [0] * len(spec.churn)
+    rejoin_at: List[List[list]] = [[] for _ in range(T + 1)]
+    msg_id = 0
+
+    for t in range(T):
+        for ids in rejoin_at[t]:
+            ids = [i for i in ids if not alive[i]]
+            if ids:
+                events.sub[t][ids] = True
+                alive[ids] = True
+        for kind, payload in churn_events[t]:
+            if kind == "phase":
+                ci = payload
+                ph = spec.churn[ci]
+                if ph.peers is not None:
+                    k0 = churn_cursor[ci]
+                    victims = [
+                        p for p in ph.peers[k0 : k0 + ph.kills_per_event]
+                        if 0 <= p < n
+                    ]
+                    churn_cursor[ci] = k0 + ph.kills_per_event
+                else:
+                    pool = np.flatnonzero(alive & ~protected)
+                    take = min(ph.kills_per_event, len(pool))
+                    victims = (
+                        churn_rngs[ci].choice(pool, size=take, replace=False)
+                        .tolist() if take else []
+                    )
+                if not victims:
+                    continue
+                field = events.leave if ph.graceful else events.kill
+                field[t][victims] = True
+                alive[victims] = False
+                if ph.rejoin_after is not None:
+                    back = t + ph.rejoin_after
+                    if back <= T - 1:
+                        rejoin_at[back].append(victims)
+            elif kind == "fault_kill":
+                ids = [i for i in payload if 0 <= i < n]
+                events.kill[t][ids] = True
+                alive[ids] = False
+            else:
+                ids = [i for i in payload if 0 <= i < n]
+                events.leave[t][ids] = True
+                alive[ids] = False
+        for _ in range(requests[t]):
+            sched.add_publish(events, t, {"msg": msg_id})
+            msg_id += 1
+
+    return CompiledScenario(
+        spec=spec, model=model, state=st, events=events,
+        attackers=None, target=None, n_publishes=n_publishes,
+    )

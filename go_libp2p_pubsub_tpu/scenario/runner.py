@@ -1,0 +1,202 @@
+"""Scenario execution: run campaigns, grade SLOs, write replayable traces.
+
+``run_scenario`` compiles a spec, executes the whole campaign in the
+model's single-scan ``rollout_events`` with ``record=True``, and grades
+the flight record into a :class:`~.slo.Verdict`.  ``save_trace`` persists
+(spec + seed + flight record) as one JSON document; ``replay_trace``
+re-compiles the embedded spec, re-runs it, and compares the fresh flight
+record against the stored one bit-for-bit.
+
+Bit-for-bit means EXACT: floats go through ``float.hex`` (no decimal
+rounding — ``utils.metrics.flight_summary`` rounds to 6dp and is therefore
+a display surface, not a replay surface), NaN/Inf become explicit tokens,
+and the replay comparison is string equality on the re-encoded record.
+Determinism holds because the event tensors are a pure function of the
+spec (host ``default_rng`` substreams) and the scan itself is one XLA
+program replayed on the same input — same spec + same seed => the same
+program on the same bytes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from . import slo as slo_mod
+from .compiler import CompiledScenario, compile_scenario
+from .spec import ScenarioSpec
+
+TRACE_VERSION = 1
+
+
+@dataclasses.dataclass
+class ScenarioResult:
+    """One executed campaign: the compiled form, device outputs, verdict."""
+
+    compiled: CompiledScenario
+    final_state: Any
+    record: Dict[str, np.ndarray]
+    verdict: slo_mod.Verdict
+
+    @property
+    def spec(self) -> ScenarioSpec:
+        return self.compiled.spec
+
+
+def _run_compiled(comp: CompiledScenario):
+    """Dispatch to the family's ``rollout_events`` (record=True)."""
+    import jax.numpy as jnp
+
+    if comp.spec.family == "gossipsub":
+        att = (
+            jnp.asarray(comp.attackers) if comp.attackers is not None else None
+        )
+        return comp.model.rollout_events(
+            comp.state, comp.events, attackers=att, target=comp.target,
+            record=True,
+        )
+    return comp.model.rollout_events(comp.state, comp.events, record=True)
+
+
+def run_scenario(
+    spec_or_compiled: Union[ScenarioSpec, CompiledScenario],
+) -> ScenarioResult:
+    """Compile (if needed) and execute one scenario, verdict included."""
+    comp = (
+        spec_or_compiled
+        if isinstance(spec_or_compiled, CompiledScenario)
+        else compile_scenario(spec_or_compiled)
+    )
+    final, record_dev = _run_compiled(comp)
+    record = {k: np.asarray(v) for k, v in record_dev.items()}
+    verdict = slo_mod.evaluate(comp.spec, record, comp.n_publishes)
+    return ScenarioResult(
+        compiled=comp, final_state=final, record=record, verdict=verdict
+    )
+
+
+def run_suite(
+    specs: List[ScenarioSpec],
+) -> List[ScenarioResult]:
+    """Run a list of scenarios in order -> their results (one process,
+    one device; each campaign is still a single scan)."""
+    return [run_scenario(s) for s in specs]
+
+
+# ---------------------------------------------------------------------------
+# exact-float flight-record encoding
+# ---------------------------------------------------------------------------
+
+def _encode_scalar(x) -> Any:
+    if isinstance(x, (bool, np.bool_)):
+        return bool(x)
+    if isinstance(x, (int, np.integer)):
+        return int(x)
+    f = float(x)
+    if np.isnan(f):
+        return "NaN"
+    if np.isinf(f):
+        return "Infinity" if f > 0 else "-Infinity"
+    # float.hex round-trips the exact bit pattern; repr-decimal does too in
+    # CPython, but hex makes the exactness contract explicit in the file.
+    return f.hex()
+
+
+def _encode_array(a: np.ndarray) -> Any:
+    if a.ndim == 0:
+        return _encode_scalar(a[()])
+    return [_encode_array(x) for x in a]
+
+
+def flight_to_jsonable(record: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """Flight record -> JSON-safe dict with EXACT float encoding (hex
+    floats, NaN/Inf tokens) — the replay-comparison surface."""
+    out = {}
+    for k in sorted(record):
+        arr = np.asarray(record[k])
+        out[k] = {
+            "dtype": str(arr.dtype),
+            "shape": list(arr.shape),
+            "data": _encode_array(arr),
+        }
+    return out
+
+
+def _decode_scalar(x, dtype: np.dtype):
+    if isinstance(x, str):
+        if x == "NaN":
+            return dtype.type(np.nan)
+        if x == "Infinity":
+            return dtype.type(np.inf)
+        if x == "-Infinity":
+            return dtype.type(-np.inf)
+        return dtype.type(float.fromhex(x))
+    return dtype.type(x)
+
+
+def jsonable_to_flight(doc: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`flight_to_jsonable`."""
+    out = {}
+    for k, ent in doc.items():
+        dtype = np.dtype(ent["dtype"])
+
+        def conv(x):
+            if isinstance(x, list):
+                return [conv(v) for v in x]
+            return _decode_scalar(x, dtype)
+
+        out[k] = np.asarray(conv(ent["data"]), dtype=dtype).reshape(
+            ent["shape"]
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# traces: save + bit-for-bit replay
+# ---------------------------------------------------------------------------
+
+def trace_document(result: ScenarioResult) -> Dict[str, Any]:
+    """The replayable trace: spec + seed + flight record + verdict."""
+    return {
+        "trace_version": TRACE_VERSION,
+        "spec": result.spec.to_dict(),
+        "seed": result.spec.seed,
+        "n_publishes": result.compiled.n_publishes,
+        "flight": flight_to_jsonable(result.record),
+        "verdict": result.verdict.to_dict(),
+    }
+
+
+def save_trace(path: str, result: ScenarioResult) -> None:
+    with open(path, "w") as f:
+        json.dump(trace_document(result), f, sort_keys=True, indent=1)
+        f.write("\n")
+
+
+def replay_trace(
+    path_or_doc: Union[str, Dict[str, Any]],
+) -> Tuple[ScenarioResult, bool, List[str]]:
+    """Re-run a saved trace's spec and compare flight records EXACTLY.
+
+    Returns ``(fresh_result, matched, mismatched_channels)`` where
+    ``matched`` is True iff every channel of the fresh flight record
+    re-encodes to exactly the stored bytes (same dtype, shape, and bit
+    pattern for every value — NaNs compare equal by token).
+    """
+    if isinstance(path_or_doc, str):
+        with open(path_or_doc) as f:
+            doc = json.load(f)
+    else:
+        doc = path_or_doc
+    spec = ScenarioSpec.from_dict(doc["spec"])
+    result = run_scenario(spec)
+    fresh = flight_to_jsonable(result.record)
+    stored = doc["flight"]
+    mismatches = [
+        k for k in sorted(set(fresh) | set(stored))
+        if fresh.get(k) != stored.get(k)
+    ]
+    return result, not mismatches, mismatches

@@ -1,0 +1,157 @@
+"""SLO verdicts: grade a scenario's flight record against its thresholds.
+
+Each enabled :class:`~.spec.SLO` field becomes one :class:`Criterion` with
+the measured value next to the threshold, so a failing verdict says not
+just *that* the campaign regressed but *which* guarantee broke and by how
+much.  Every measurement is sourced from the flight record the rollout
+scan emitted (PR 1's recorder plus the campaign channels) — the verdict
+is a pure host-side reduction of device telemetry, never a re-simulation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .spec import SLO, ScenarioSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class Criterion:
+    """One graded threshold: ``actual`` measured vs ``threshold`` bound."""
+
+    name: str
+    kind: str            # "max" | "min"
+    threshold: float
+    actual: float
+    passed: bool
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class Verdict:
+    """A scenario's pass/fail with the per-criterion breakdown."""
+
+    scenario: str
+    passed: bool
+    criteria: List[Criterion]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "scenario": self.scenario,
+            "passed": self.passed,
+            "criteria": [c.to_dict() for c in self.criteria],
+        }
+
+    def __str__(self) -> str:
+        rows = [
+            f"  {'PASS' if c.passed else 'FAIL'}  {c.name}: "
+            f"{c.actual:.4g} ({c.kind} {c.threshold:.4g})"
+            for c in self.criteria
+        ]
+        head = f"{'PASS' if self.passed else 'FAIL'}  {self.scenario}"
+        return "\n".join([head] + rows)
+
+
+def _crit(name: str, kind: str, threshold, actual) -> Criterion:
+    actual = float(actual)
+    threshold = float(threshold)
+    ok = actual <= threshold if kind == "max" else actual >= threshold
+    # NaN never passes: a criterion that could not be measured is a failure
+    # of the scenario, not a vacuous success.
+    if not np.isfinite(actual):
+        ok = False
+    return Criterion(name, kind, threshold, actual, bool(ok))
+
+
+def evaluate(
+    spec: ScenarioSpec,
+    record: Dict[str, np.ndarray],
+    n_publishes: int,
+) -> Verdict:
+    """Grade ``record`` (host-side flight record, time axis leading)
+    against ``spec.slo`` -> :class:`Verdict`."""
+    slo: SLO = spec.slo
+    crits: List[Criterion] = []
+
+    def have(key: str) -> bool:
+        return key in record
+
+    if spec.family == "treecast":
+        if slo.min_delivered_total is not None:
+            crits.append(_crit(
+                "delivered_total", "min", slo.min_delivered_total,
+                record["msgs_delivered_total"][-1],
+            ))
+        if slo.max_final_orphans is not None:
+            crits.append(_crit(
+                "final_orphans", "max", slo.max_final_orphans,
+                record["peers_orphaned"][-1],
+            ))
+        if slo.min_delivery_frac is not None:
+            # The tree record counts total receipts, not per-message rows:
+            # normalize by the ideal receipt count (every publish reaching
+            # every finally-alive peer).
+            alive = float(record["peers_alive"][-1])
+            ideal = max(n_publishes * alive, 1.0)
+            crits.append(_crit(
+                "delivery_frac", "min", slo.min_delivery_frac,
+                float(record["msgs_delivered_total"][-1]) / ideal,
+            ))
+    else:
+        from ..ops import histogram as hist_ops
+
+        if slo.min_delivery_frac is not None:
+            crits.append(_crit(
+                "delivery_frac", "min", slo.min_delivery_frac,
+                record["delivery_frac"][-1],
+            ))
+        if slo.max_p50 is not None or slo.max_p99 is not None:
+            final_hist = np.asarray(record["lat_hist"][-1])
+            if slo.max_p50 is not None:
+                crits.append(_crit(
+                    "latency_p50", "max", slo.max_p50,
+                    hist_ops.hist_quantile(final_hist, 0.5),
+                ))
+            if slo.max_p99 is not None:
+                crits.append(_crit(
+                    "latency_p99", "max", slo.max_p99,
+                    hist_ops.hist_quantile(final_hist, 0.99),
+                ))
+        if slo.max_capture_frac is not None:
+            if not have("attacker_capture_frac"):
+                raise ValueError(
+                    "max_capture_frac SLO needs an attack wave (the "
+                    "attacker channels are only recorded with attackers)"
+                )
+            crits.append(_crit(
+                "capture_frac_peak", "max", slo.max_capture_frac,
+                np.max(record["attacker_capture_frac"]),
+            ))
+        if slo.max_final_attacker_mesh_edges is not None:
+            crits.append(_crit(
+                "final_attacker_mesh_edges", "max",
+                slo.max_final_attacker_mesh_edges,
+                record["attacker_mesh_edges"][-1],
+            ))
+        if slo.min_final_target_honest_edges is not None:
+            if not have("target_honest_mesh_edges"):
+                raise ValueError(
+                    "min_final_target_honest_edges SLO needs an eclipse "
+                    "wave (no target, no target channel)"
+                )
+            crits.append(_crit(
+                "final_target_honest_edges", "min",
+                slo.min_final_target_honest_edges,
+                record["target_honest_mesh_edges"][-1],
+            ))
+
+    return Verdict(
+        scenario=spec.name,
+        passed=all(c.passed for c in crits),
+        criteria=crits,
+    )

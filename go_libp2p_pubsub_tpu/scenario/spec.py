@@ -1,0 +1,246 @@
+"""Declarative scenario specs: an adversity campaign as serializable data.
+
+BASELINE config (d) and the ROADMAP's "handle as many scenarios as you can
+imagine" were served by three disconnected mechanisms — ``utils.faults``
+FaultPlans, ``models/attacks.py`` ad-hoc runners, and per-test link
+profiles.  A :class:`ScenarioSpec` composes all of them onto ONE timeline:
+phased churn (abrupt or graceful, with optional rejoin), attack waves
+(sybil colocation, eclipse, invalid spam, gossip-promise spam, backoff
+graft spam), link-degradation windows, and traffic workload generators
+(constant / burst / hot-publisher), plus the SLO thresholds the run is
+graded against.
+
+A spec is pure data: dataclasses with an exact JSON round-trip
+(``to_dict``/``from_dict``/``to_json``/``from_json``), so a scenario can be
+committed, diffed, and replayed bit-for-bit (``scenario.runner.save_trace``
+stores the spec next to the flight record it produced).  All randomness is
+derived from ``seed`` through per-component substreams at compile time —
+the lowered event tensors are a pure function of the spec.
+
+Lowering to device event tensors lives in ``scenario.compiler``; execution
+and verdicts in ``scenario.runner``; the named canon in ``scenario.canon``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+FAMILIES = ("gossipsub", "treecast", "multitopic")
+WORKLOAD_KINDS = ("constant", "burst", "hot")
+ATTACK_KINDS = ("sybil", "eclipse", "spam", "promise_spam", "graft_spam")
+
+
+@dataclass(frozen=True)
+class Workload:
+    """A traffic generator on the scenario timeline.
+
+    - ``constant``: ``n_msgs`` publishes every ``every`` steps over
+      [start, stop), each from a random honest alive peer (or ``src``).
+    - ``burst``: ``n_msgs`` publishes all at ``start`` (flash crowd), each
+      from a distinct random honest peer unless ``src`` pins one.
+    - ``hot``: like constant but REQUIRES ``src`` — the hot-publisher
+      pattern (one peer produces the topic's whole feed).
+    """
+
+    kind: str = "constant"
+    start: int = 0
+    stop: Optional[int] = None     # exclusive; None = scenario end
+    every: int = 1
+    n_msgs: int = 1                # per event (burst: total, at `start`)
+    src: Optional[int] = None
+    valid: bool = True
+    topic: int = 0                 # multitopic family only
+
+    def __post_init__(self) -> None:
+        if self.kind not in WORKLOAD_KINDS:
+            raise ValueError(f"unknown workload kind {self.kind!r}")
+        if self.kind == "hot" and self.src is None:
+            raise ValueError("hot workload requires src")
+        if self.every < 1:
+            raise ValueError("workload every must be >= 1")
+        if self.n_msgs < 1:
+            raise ValueError("workload n_msgs must be >= 1")
+
+
+@dataclass(frozen=True)
+class ChurnPhase:
+    """A window of membership churn.
+
+    Every ``every`` steps in [start, stop), ``kills_per_event`` victims are
+    drawn (random honest alive peers, or cycled from ``peers``) and either
+    killed abruptly (default) or removed gracefully (``graceful=True``:
+    unsubscribe for the mesh families, Part for the tree).  With
+    ``rejoin_after`` set, each victim comes back that many steps later
+    (revive / resubscribe / join walk) — churn with rejoin, or with a
+    single event, partition-and-heal.
+    """
+
+    start: int = 0
+    stop: int = 1
+    every: int = 8
+    kills_per_event: int = 1
+    graceful: bool = False
+    rejoin_after: Optional[int] = None
+    peers: Optional[List[int]] = None
+
+    def __post_init__(self) -> None:
+        if self.every < 1:
+            raise ValueError("churn every must be >= 1")
+        if self.stop <= self.start:
+            raise ValueError("churn stop must be > start")
+
+
+@dataclass(frozen=True)
+class AttackWave:
+    """An adversary campaign window (gossipsub family; ``spam`` and
+    ``promise_spam`` also lower for multitopic).
+
+    - ``sybil``: peers [0, n_attackers) share one IP-colocation group for
+      the whole run (P6 defense under test).
+    - ``eclipse``: the attackers are the ``target``'s CONVERGED mesh at
+      scenario start (derived at compile time); during [start, stop) they
+      receive but never relay (post-step silence) and never serve IWANTs
+      (gossip mute).  ``spam_every``/``graft_spam`` compose spam flavors
+      onto the same attacker set.
+    - ``spam``: attackers [0, n_attackers) publish one invalid message each
+      every ``spam_every`` steps in [start, stop) (P4 defense).
+    - ``promise_spam``: attackers advertise but never serve IWANTs during
+      the window (P7 promise tracking).
+    - ``graft_spam``: attackers re-GRAFT through their prune-backoff every
+      heartbeat for the WHOLE run (constructor-bound ``graft_spammers``),
+      plus the window's invalid spam when ``spam_every > 0`` (P7 backoff
+      violations).
+    """
+
+    kind: str = "spam"
+    start: int = 0
+    stop: Optional[int] = None     # exclusive; None = scenario end
+    n_attackers: int = 0
+    target: Optional[int] = None   # eclipse only
+    spam_every: int = 0            # 0 = no spam publishes
+    graft_spam: bool = False       # also bind attackers as graft spammers
+
+    def __post_init__(self) -> None:
+        if self.kind not in ATTACK_KINDS:
+            raise ValueError(f"unknown attack kind {self.kind!r}")
+        if self.kind == "eclipse" and self.target is None:
+            raise ValueError("eclipse wave requires target")
+        if self.kind != "eclipse" and self.n_attackers < 1:
+            raise ValueError(f"{self.kind} wave requires n_attackers >= 1")
+        if self.kind == "spam" and self.spam_every < 1:
+            raise ValueError("spam wave requires spam_every >= 1")
+
+
+@dataclass(frozen=True)
+class LinkWindow:
+    """A link-degradation window: ingress gossip delay ``delay`` (rounds)
+    installed on ``peers`` (or a random ``frac`` of peers) during
+    [start, stop), restored to the ideal fabric at ``stop``."""
+
+    start: int = 0
+    stop: int = 1
+    delay: int = 1
+    peers: Optional[List[int]] = None
+    frac: float = 0.0
+
+    def __post_init__(self) -> None:
+        if self.stop <= self.start:
+            raise ValueError("link window stop must be > start")
+        if self.delay < 0:
+            raise ValueError("link delay must be >= 0")
+        if self.peers is None and not (0.0 < self.frac <= 1.0):
+            raise ValueError("link window needs peers or frac in (0, 1]")
+
+
+@dataclass(frozen=True)
+class SLO:
+    """Pass/fail thresholds graded from the run's flight record.  ``None``
+    disables a criterion.  Latency criteria read the PR-1 histogram
+    (``hist_quantile`` over the final cumulative ``lat_hist`` row);
+    delivery reads the final state's delivery stats; capture reads the
+    attacker channels; the ``*_total``/``orphans`` criteria are the tree
+    family's delivery surface (the tree record has no latency histogram,
+    so latency SLOs are rejected there at compile time)."""
+
+    min_delivery_frac: Optional[float] = None
+    max_p50: Optional[float] = None                  # rounds
+    max_p99: Optional[float] = None                  # rounds
+    max_capture_frac: Optional[float] = None         # max over the series
+    max_final_attacker_mesh_edges: Optional[int] = None
+    min_final_target_honest_edges: Optional[int] = None
+    min_delivered_total: Optional[int] = None        # tree
+    max_final_orphans: Optional[int] = None          # tree
+
+
+@dataclass
+class ScenarioSpec:
+    """One named, seeded, fully declarative adversity campaign."""
+
+    name: str
+    family: str = "gossipsub"
+    n_steps: int = 32
+    seed: int = 0
+    model: Dict[str, Any] = field(default_factory=dict)
+    workloads: List[Workload] = field(default_factory=list)
+    churn: List[ChurnPhase] = field(default_factory=list)
+    attacks: List[AttackWave] = field(default_factory=list)
+    links: List[LinkWindow] = field(default_factory=list)
+    # Bridge for existing FaultPlan schedules: {"kills": {step: [ids]},
+    # "leaves": {step: [ids]}} — the compiler lowers them alongside churn
+    # (see ScenarioSpec.from_fault_plan / compiler._lower_faults).
+    faults: Optional[Dict[str, Dict[str, List[int]]]] = None
+    slo: SLO = field(default_factory=SLO)
+    description: str = ""
+
+    def __post_init__(self) -> None:
+        if self.family not in FAMILIES:
+            raise ValueError(f"unknown family {self.family!r}")
+        if self.n_steps < 1:
+            raise ValueError("n_steps must be >= 1")
+
+    # -- FaultPlan bridge ---------------------------------------------------
+
+    @classmethod
+    def from_fault_plan(cls, name: str, plan, n_steps: int, **kw):
+        """Wrap a ``utils.faults.FaultPlan`` as a scenario (kills/leaves
+        become the spec's ``faults`` schedule; everything else from kw)."""
+        import numpy as np
+
+        faults = {
+            "kills": {
+                str(t): [int(i) for i in np.flatnonzero(m)]
+                for t, m in sorted(plan.kills.items())
+            },
+            "leaves": {
+                str(t): [int(i) for i in np.flatnonzero(m)]
+                for t, m in sorted(plan.leaves.items())
+            },
+        }
+        return cls(name=name, n_steps=n_steps, faults=faults, **kw)
+
+    # -- JSON round-trip ----------------------------------------------------
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        return d
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True, indent=2)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ScenarioSpec":
+        d = dict(d)
+        d["workloads"] = [Workload(**w) for w in d.get("workloads", [])]
+        d["churn"] = [ChurnPhase(**c) for c in d.get("churn", [])]
+        d["attacks"] = [AttackWave(**a) for a in d.get("attacks", [])]
+        d["links"] = [LinkWindow(**l) for l in d.get("links", [])]
+        slo = d.get("slo", {})
+        d["slo"] = slo if isinstance(slo, SLO) else SLO(**slo)
+        return cls(**d)
+
+    @classmethod
+    def from_json(cls, s: str) -> "ScenarioSpec":
+        return cls.from_dict(json.loads(s))

@@ -34,16 +34,58 @@ class FaultPlan:
     kills: Dict[int, np.ndarray] = dataclasses.field(default_factory=dict)
     leaves: Dict[int, np.ndarray] = dataclasses.field(default_factory=dict)
 
+    @staticmethod
+    def _as_mask(peers, n: int) -> np.ndarray:
+        """``peers`` (index list or bool mask) -> validated bool[n].
+
+        Rejects out-of-range indices (negative ones would silently wrap in
+        fancy indexing — a plan targeting peer ``n`` or ``-1`` is a bug in
+        the caller, not a request for the last row) and bool masks whose
+        length disagrees with ``n``.
+        """
+        peers = np.asarray(peers)
+        if peers.size == 0:
+            return np.zeros(n, bool)
+        if peers.dtype == bool:
+            if peers.shape != (n,):
+                raise ValueError(
+                    f"bool peer mask has shape {peers.shape}, expected ({n},)"
+                )
+            return peers.copy()
+        if peers.size and not np.issubdtype(peers.dtype, np.integer):
+            raise TypeError(
+                f"peers must be integer indices or a bool mask, got dtype "
+                f"{peers.dtype}"
+            )
+        if peers.size and (peers.min() < 0 or peers.max() >= n):
+            bad = peers[(peers < 0) | (peers >= n)]
+            raise ValueError(
+                f"peer indices {bad.tolist()} out of range [0, {n})"
+            )
+        m = np.zeros(n, bool)
+        m[peers] = True
+        return m
+
     def kill_at(self, step: int, peers, n: int) -> "FaultPlan":
-        m = self.kills.get(step, np.zeros(n, bool)).copy()
-        m[np.asarray(peers)] = True
-        self.kills[step] = m
+        mask = self._as_mask(peers, n)
+        prev = self.kills.get(step)
+        if prev is not None and prev.shape != (n,):
+            raise ValueError(
+                f"step {step} already has a kill mask for n={prev.shape[0]}, "
+                f"cannot extend it with n={n}"
+            )
+        self.kills[step] = mask if prev is None else (prev | mask)
         return self
 
     def leave_at(self, step: int, peers, n: int) -> "FaultPlan":
-        m = self.leaves.get(step, np.zeros(n, bool)).copy()
-        m[np.asarray(peers)] = True
-        self.leaves[step] = m
+        mask = self._as_mask(peers, n)
+        prev = self.leaves.get(step)
+        if prev is not None and prev.shape != (n,):
+            raise ValueError(
+                f"step {step} already has a leave mask for n={prev.shape[0]}, "
+                f"cannot extend it with n={n}"
+            )
+        self.leaves[step] = mask if prev is None else (prev | mask)
         return self
 
     def event_steps(self) -> List[int]:
@@ -71,6 +113,12 @@ def run_with_faults(
     leave_fn: Optional[Callable] = None,
 ):
     """Drive ``run_fn(st, k)`` for ``n_steps``, applying plan events.
+
+    LEGACY host-segmented path: the scenario engine lowers the same plan to
+    device event tensors instead (``scenario.ScenarioSpec.from_fault_plan``
+    -> one un-segmented ``rollout_events`` scan).  Kept for callers that
+    need custom ``kill_fn`` semantics or un-lowered state edits between
+    segments.
 
     The rollout is segmented at event steps: scan between events (device
     speed), apply mask edits at the boundary (one tiny host round-trip per

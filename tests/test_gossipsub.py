@@ -292,6 +292,56 @@ def test_idontwant_model_cuts_duplicates_only():
         )
 
 
+def test_idontwant_wire_lag_weakens_suppression_only():
+    """``idontwant_wire_lag=True`` snapshots possession one round older
+    (wire parity: an IDONTWANT for a message received this round cannot
+    reach the sender before its next-round relay).  The lagged config must
+    suppress FEWER duplicates than the instant model (strictly, when
+    suppression bites at all) while leaving deliveries and every other
+    state leaf identical — it only moves which duplicates are counted."""
+    import jax
+
+    from go_libp2p_pubsub_tpu.config import GossipSubParams
+
+    kw = dict(n_peers=96, n_slots=16, conn_degree=10, msg_window=32,
+              use_pallas=False)
+    g_off = GossipSub(params=GossipSubParams(idontwant=False), **kw)
+    g_on = GossipSub(params=GossipSubParams(idontwant=True), **kw)
+    g_lag = GossipSub(
+        params=GossipSubParams(idontwant=True, idontwant_wire_lag=True), **kw
+    )
+    states = [g.init(seed=4) for g in (g_off, g_on, g_lag)]
+    for s in range(6):
+        states = [
+            g.publish(st, jnp.int32(s * 5), jnp.int32(s), jnp.asarray(True))
+            for g, st in zip((g_off, g_on, g_lag), states)
+        ]
+    s_off, s_on, s_lag = (
+        g.run(st, 20) for g, st in zip((g_off, g_on, g_lag), states)
+    )
+    mmd = [
+        float(np.asarray(s.counters.mesh_message_deliveries).sum())
+        for s in (s_off, s_on, s_lag)
+    ]
+    assert mmd[1] < mmd[0], "instant suppression never bit"
+    assert mmd[1] < mmd[2] <= mmd[0], (
+        f"wire lag must sit strictly between instant suppression and none, "
+        f"got off={mmd[0]} on={mmd[1]} lag={mmd[2]}"
+    )
+    # Deliveries (and every non-counter leaf) are unaffected by the lag.
+    for name in type(s_on)._fields:
+        if name == "counters":
+            continue
+        for la, lb in zip(
+            jax.tree.leaves(getattr(s_on, name)),
+            jax.tree.leaves(getattr(s_lag, name)),
+        ):
+            np.testing.assert_array_equal(
+                np.asarray(la), np.asarray(lb),
+                err_msg=f"field {name} diverged under wire lag",
+            )
+
+
 def test_direct_peering_always_forwards_and_stays_out_of_mesh():
     """go-gossipsub WithDirectPeers analog: a direct edge relays every
     round even when the remote's score is below the graylist threshold

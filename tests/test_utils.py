@@ -188,6 +188,35 @@ class TestFaults:
                 lambda s, m: s,
             )
 
+    def test_kill_at_rejects_out_of_range_indices(self):
+        plan = faults.FaultPlan()
+        with pytest.raises(ValueError, match=r"out of range"):
+            plan.kill_at(0, [8], 8)
+        # negative indices would silently wrap under fancy indexing — the
+        # historical bug this validation exists for
+        with pytest.raises(ValueError, match=r"-1"):
+            plan.kill_at(0, [-1], 8)
+        with pytest.raises(ValueError, match=r"out of range"):
+            plan.leave_at(0, [3, 99], 8)
+        assert not plan.kills and not plan.leaves, "no partial writes"
+
+    def test_kill_at_rejects_mismatched_mask(self):
+        plan = faults.FaultPlan()
+        with pytest.raises(ValueError, match=r"shape"):
+            plan.kill_at(0, np.zeros(4, bool), 8)
+        with pytest.raises(TypeError, match=r"dtype"):
+            plan.kill_at(0, np.array([0.5, 1.5]), 8)
+
+    def test_kill_at_accepts_mask_and_merges(self):
+        plan = faults.FaultPlan()
+        mask = np.zeros(8, bool)
+        mask[2] = True
+        plan.kill_at(3, mask, 8).kill_at(3, [5], 8)
+        assert plan.kills[3][2] and plan.kills[3][5]
+        assert plan.kills[3].sum() == 2
+        with pytest.raises(ValueError, match=r"n=8"):
+            plan.kill_at(3, [1], 16)
+
     def test_sybil_groups(self):
         g = faults.sybil_ip_groups(16, 4)
         assert (g[:4] == 0).all()

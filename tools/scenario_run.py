@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Run scenario campaigns and print their SLO verdicts.
+
+Usage::
+
+    python tools/scenario_run.py                      # whole canon suite
+    python tools/scenario_run.py steady_state churn_10pct
+    python tools/scenario_run.py --list               # name the canon
+    python tools/scenario_run.py --spec my.json       # a spec file
+    python tools/scenario_run.py steady_state --save-trace trace.json
+    python tools/scenario_run.py --replay trace.json  # bit-for-bit check
+    python tools/scenario_run.py --json               # machine-readable
+
+Exit code 0 iff every verdict passed (and, with ``--replay``, the stored
+flight record reproduced exactly) — the scenario suite is a regression
+gate, not a demo (PERF.md "Scenario verdicts").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from go_libp2p_pubsub_tpu import scenario  # noqa: E402
+
+
+def _verdict_table(results) -> str:
+    rows = []
+    width = max((len(r.spec.name) for r in results), default=8)
+    for r in results:
+        v = r.verdict
+        crit = "; ".join(
+            f"{c.name}={c.actual:.4g} ({'<=' if c.kind == 'max' else '>='} "
+            f"{c.threshold:.4g}){'' if c.passed else ' FAIL'}"
+            for c in v.criteria
+        ) or "(no criteria)"
+        rows.append(
+            f"{'PASS' if v.passed else 'FAIL'}  "
+            f"{r.spec.name:<{width}}  {r.spec.family:<10}  {crit}"
+        )
+    return "\n".join(rows)
+
+
+def main(argv: List[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("names", nargs="*", help="canon scenario names "
+                    "(default: the whole canon)")
+    ap.add_argument("--list", action="store_true", help="list canon names")
+    ap.add_argument("--spec", action="append", default=[],
+                    help="run a ScenarioSpec JSON file (repeatable)")
+    ap.add_argument("--replay", action="append", default=[],
+                    help="replay a saved trace and require an exact match "
+                    "(repeatable)")
+    ap.add_argument("--save-trace", metavar="PATH",
+                    help="write the (single) run's replayable trace here")
+    ap.add_argument("--json", action="store_true",
+                    help="emit verdicts as JSON instead of the table")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name, builder in scenario.CANON.items():
+            print(f"{name:<26} {builder().description}")
+        return 0
+
+    if args.replay:
+        ok_all = True
+        out = []
+        for path in args.replay:
+            t0 = time.time()
+            result, ok, bad = scenario.replay_trace(path)
+            ok_all &= ok and result.verdict.passed
+            out.append({
+                "trace": path,
+                "replay_exact": ok,
+                "mismatched_channels": bad,
+                "verdict": result.verdict.to_dict(),
+                "seconds": round(time.time() - t0, 3),
+            })
+            if not args.json:
+                state = "EXACT" if ok else f"MISMATCH {bad}"
+                print(f"{'PASS' if ok else 'FAIL'}  replay {path}: {state}")
+        if args.json:
+            print(json.dumps(out, indent=2))
+        return 0 if ok_all else 1
+
+    specs = []
+    for path in args.spec:
+        with open(path) as f:
+            specs.append(scenario.ScenarioSpec.from_json(f.read()))
+    specs.extend(scenario.build_all(args.names or None))
+
+    if args.save_trace and len(specs) != 1:
+        ap.error("--save-trace takes exactly one scenario")
+
+    results = []
+    for spec in specs:
+        t0 = time.time()
+        res = scenario.run_scenario(spec)
+        res.seconds = round(time.time() - t0, 3)
+        results.append(res)
+
+    if args.save_trace:
+        scenario.save_trace(args.save_trace, results[0])
+
+    if args.json:
+        print(json.dumps(
+            [dict(res.verdict.to_dict(), family=res.spec.family,
+                  n_publishes=res.compiled.n_publishes,
+                  seconds=res.seconds)
+             for res in results],
+            indent=2,
+        ))
+    else:
+        print(_verdict_table(results))
+        n_fail = sum(not r.verdict.passed for r in results)
+        print(f"\n{len(results) - n_fail}/{len(results)} scenarios passed")
+    return 0 if all(r.verdict.passed for r in results) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
