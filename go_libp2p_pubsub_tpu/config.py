@@ -65,6 +65,40 @@ class TreeOpts:
 
 
 @dataclass(frozen=True)
+class RetryOpts:
+    """Retry/backoff budget for the live plane's control paths
+    (``net/policy.py``).
+
+    The reference has no retry layer at all — each dial is one attempt and
+    the only deadline is ``SubRepairTimeout`` (``client.go:14``).  These
+    defaults keep the clean path invisible (first attempt, no sleeps) while
+    bounding how long a faulted path may thrash: attempts are capped, the
+    decorrelated-jitter backoff is capped per sleep (``max_delay_s``) and
+    overall (``deadline_s``), and ``breaker_failures`` consecutive failures
+    open a per-class circuit breaker that fast-fails until ``breaker_reset_s``
+    elapses.
+    """
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    deadline_s: float = 10.0
+    breaker_failures: int = 16
+    breaker_reset_s: float = 2.0
+
+    def __post_init__(self) -> None:
+        _validate_positive("max_attempts", self.max_attempts, 1 << 10)
+        _validate_positive("breaker_failures", self.breaker_failures, 1 << 20)
+        if self.base_delay_s <= 0 or self.max_delay_s < self.base_delay_s:
+            raise ValueError(
+                "require 0 < base_delay_s <= max_delay_s, got "
+                f"{self.base_delay_s}/{self.max_delay_s}"
+            )
+        if self.deadline_s <= 0 or self.breaker_reset_s <= 0:
+            raise ValueError("deadline_s and breaker_reset_s must be > 0")
+
+
+@dataclass(frozen=True)
 class SimParams:
     """Shape parameters of the array-resident simulation state.
 

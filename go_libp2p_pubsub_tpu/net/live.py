@@ -38,16 +38,21 @@ sim engine so both planes behave identically:
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
+import dataclasses
+import hashlib
 import json
 import threading
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
-from ..config import SUB_REPAIR_TIMEOUT_S, DELIVERY_BUFFER, TreeOpts
+from ..config import SUB_REPAIR_TIMEOUT_S, DELIVERY_BUFFER, RetryOpts, TreeOpts
 from ..crypto.pipeline import Envelope, ValidationPipeline, sign_envelope
 from ..utils.log import get_logger, kv
 from ..utils.metrics import MetricsRegistry
 from ..wire import Message, MessageType
+from .policy import LiveCallTimeout, RetryPolicy
 from .transport import LiveHost, Peerstore, Stream, StreamClosed
 
 MAX_JOIN_HOPS = 64  # bound on the redirect walk (reference: unbounded recursion)
@@ -163,7 +168,10 @@ class _BatchValidator:
                     self.rejected_signature += 1
                     continue
                 if env.seqno <= self.last_seqno:
-                    self.rejected_structural += 1
+                    # A repair replay of an envelope this subscriber already
+                    # consumed is expected traffic, not a replay attack.
+                    if not m.replay:
+                        self.rejected_structural += 1
                     continue
                 self.last_seqno = env.seqno
                 await self.sub.out.put(env.payload)
@@ -178,6 +186,21 @@ class _Child:
     size: int = 1              # subtree size incl. the child itself
     child_ids: List[str] = field(default_factory=list)  # its direct children
     dead: bool = False
+    # Forward-log index at admission: forwards with idx >= this reached the
+    # child directly, earlier ones predate it (the repair-replay boundary).
+    admitted_fwd_idx: int = 0
+
+
+# Repair-replay window: how many recent DATA forwards a node keeps for
+# re-sending to a re-adopted orphan.  Repair completes within a few dials
+# (milliseconds-to-seconds); 32 messages of history covers any plausible
+# number of publishes inside that window at a bounded memory cost.
+FWD_LOG_CAP = 32
+
+# Replay-dedup window at each subscriber: payload digests of the most
+# recently seen Data frames.  Must be >= FWD_LOG_CAP so no replayed frame
+# can outlive the memory of its original delivery.
+SEEN_DATA_CAP = 256
 
 
 class _TreeNode:
@@ -191,6 +214,7 @@ class _TreeNode:
         opts: TreeOpts,
         repair_timeout_s: float = SUB_REPAIR_TIMEOUT_S,
         metrics: Optional[MetricsRegistry] = None,
+        retry: Optional[RetryPolicy] = None,
     ) -> None:
         self.host = host
         self.protoid = protoid
@@ -198,7 +222,19 @@ class _TreeNode:
         self.max_width = opts.tree_max_width
         self.repair_timeout_s = repair_timeout_s
         self.metrics = metrics  # shared registry (the /metrics counters)
+        # Every dial-shaped operation (subscribe dial, join-walk hops,
+        # adoption dials, rejoin-at-root) runs under this policy; shared per
+        # topic manager so breaker state is per (host, operation class).
+        self.retry = retry if retry is not None else RetryPolicy(registry=metrics)
         self.children: Dict[str, _Child] = {}
+        # Repair-replay log: the last FWD_LOG_CAP DATA messages this node
+        # fanned out, tagged with a monotone index.  Index assignment (in
+        # ``forward_message``) and admission stamping (in ``handle_join``)
+        # both happen in event-loop-synchronous sections, so "forwarded
+        # before this child was admitted" is a total order — the replay in
+        # ``_redistribute`` can be exact: no loss, no duplicates.
+        self._fwd_log: List[Tuple[int, Message]] = []
+        self._fwd_idx = 0
         self.chlock = asyncio.Lock()  # chlock (subtree.go:18) — held on ALL
         # admission paths, fixing the reference's unlocked Part path (§2.4.7)
         self.parent_stream: Optional[Stream] = None
@@ -206,9 +242,19 @@ class _TreeNode:
         self.root_id: Optional[str] = None  # for rejoin-at-root
         self.closed = False
 
-    def _inc(self, name: str) -> None:
+    def _inc(self, name: str, value: float = 1.0) -> None:
         if self.metrics is not None:
-            self.metrics.inc(name)
+            self.metrics.inc(name, value)
+
+    async def dial_retry(self, peer_id: str, cls: str = "dial",
+                         max_attempts: Optional[int] = None) -> Stream:
+        """Dial under the retry policy, with the attempt accounted to
+        ``cls`` (``live.retry.<cls>.*`` counters)."""
+        return await self.retry.run(
+            cls,
+            lambda: self.host.new_stream(peer_id, self.protoid),
+            max_attempts=max_attempts,
+        )
 
     # -- accounting ----------------------------------------------------------
 
@@ -270,7 +316,7 @@ class _TreeNode:
         if stale is not None:
             stale.dead = True
             stale.stream.close()
-        child = _Child(stream=s)
+        child = _Child(stream=s, admitted_fwd_idx=self._fwd_idx)
         self.children[s.remote_peer] = child
         self._inc("live.join_admitted")
         _log.info(
@@ -343,23 +389,53 @@ class _TreeNode:
                 orphans=len(child.child_ids),
             ),
         )
-        await self._redistribute(child.child_ids)
+        # Replay horizon: everything fanned out since the DEAD child was
+        # admitted.  A write into a dying socket can "succeed" into the TCP
+        # buffer and vanish, so the last confirmed-delivered message is
+        # unknowable — replay the whole uncertainty window and let the
+        # replay-flag dedup at the receivers drop what actually arrived.
+        await self._redistribute(child.child_ids, since=child.admitted_fwd_idx)
         await self.notify_parent_state()
 
-    async def _redistribute(self, grandchild_ids: List[str]) -> None:
+    async def _redistribute(self, grandchild_ids: List[str],
+                            requeued: bool = False,
+                            since: Optional[int] = None) -> None:
         """Re-adopt a dead child's children with priority capacity
         (``redistributeChildren``, ``subtree.go:356-375``) — all of them, not
-        just the newest (§2.4.4)."""
+        just the newest (§2.4.4).
+
+        Adoption dials run under the retry policy; orphans whose dials
+        exhaust it are re-queued for one deferred pass before the orphan's
+        own repair-timeout rejoin takes over — an unreachable orphan costs
+        retries, never a silently stranded subtree.
+
+        ``since`` is the forward-log index from which delivery through the
+        dead parent is uncertain (its own admission point): everything
+        logged in [since, orphan re-admission) is replayed to the fresh
+        child right after the welcome, marked with the wire ``replay`` flag
+        so receivers can drop what the dead parent did deliver."""
+        missed: List[str] = []
         for gid in grandchild_ids:
-            if self.closed or gid == self.host.id or gid in self.children:
+            if self.closed or gid == self.host.id or gid in self.live_child_ids():
                 continue
             try:
-                s = await self.host.new_stream(gid, self.protoid)
+                s = await self.dial_retry(gid, cls="adopt")
             except (StreamClosed, KeyError):
-                continue  # grandchild also gone; its subtree rejoins via timeout
+                if requeued:
+                    self._inc("live.orphan_abandoned")
+                else:
+                    missed.append(gid)
+                continue
             async with self.chlock:
-                # The orphan may have rejoined on its own while we dialed.
-                if self.closed or gid in self.children:
+                # Re-check liveness AFTER the dial completed: the orphan may
+                # have rejoined — on its own, or via a concurrent repair —
+                # while we dialed/backed off, and admitting this stream too
+                # would double-adopt it.  Part tells it this adoption lost.
+                if self.closed or gid in self.live_child_ids():
+                    try:
+                        await s.write_message(Message(type=MessageType.PART))
+                    except StreamClosed:
+                        pass
                     s.close()
                     continue
                 self._inc("live.repair_adopted")
@@ -368,6 +444,44 @@ class _TreeNode:
                     extra=kv(parent=self.host.id, grandchild=gid),
                 )
                 await self.handle_join(s, prio=True)
+                if since is not None:
+                    await self._replay_fwd_log(gid, since)
+        if missed and not self.closed:
+            self._inc("live.orphans_requeued", len(missed))
+            self.host.spawn(self._deferred_redistribute(missed, since))
+
+    async def _replay_fwd_log(self, cid: str, since: int) -> None:
+        """Close the repair loss window: re-send the DATA messages whose
+        delivery through the dead parent is uncertain to the just-admitted
+        child.  Caller holds ``chlock``; the new child's ``admitted_fwd_idx``
+        bounds the replay above (anything after it reaches the child through
+        the normal fan-out), and the wire ``replay`` flag lets every receiver
+        drop copies it already has — at-least-once on the wire, exactly-once
+        at delivery."""
+        child = self.children.get(cid)
+        if child is None or child.dead:
+            return
+        pending = [
+            dataclasses.replace(m, replay=True)
+            for i, m in self._fwd_log
+            if since <= i < child.admitted_fwd_idx
+        ]
+        for m in pending:
+            try:
+                await child.stream.write_message(m)
+            except StreamClosed:
+                return  # the fresh child died too: the next repair's problem
+        if pending:
+            self._inc("live.repair_replayed", len(pending))
+
+    async def _deferred_redistribute(self, gids: List[str],
+                                     since: Optional[int] = None) -> None:
+        """Second-chance pass for orphans whose adoption dials exhausted the
+        retry budget — scheduled well inside the repair window so a slow
+        restart is re-adopted here instead of falling back to rejoin."""
+        await asyncio.sleep(min(1.0, self.repair_timeout_s / 2))
+        if not self.closed:
+            await self._redistribute(gids, requeued=True, since=since)
 
     # -- data plane ----------------------------------------------------------
 
@@ -375,6 +489,14 @@ class _TreeNode:
         """Fan out to all live children **concurrently** (``forwardMessage``,
         ``subtree.go:319-354``, with the ``TODO: in parallel`` done).  Write
         failures mark children dead; their recorded children are re-adopted."""
+        # Log + index the fan-out in the same synchronous section that
+        # snapshots the target set: the repair replay relies on "admitted
+        # before/after forward i" being a total order.
+        if m.type == MessageType.DATA:
+            self._fwd_log.append((self._fwd_idx, m))
+            self._fwd_idx += 1
+            if len(self._fwd_log) > FWD_LOG_CAP:
+                del self._fwd_log[0]
         targets = [(cid, c) for cid, c in self.children.items() if not c.dead]
         if not targets:
             return
@@ -393,8 +515,12 @@ class _TreeNode:
         for _, c in dead:
             c.dead = True
         for cid, c in dead:
+            self._inc("live.forward_write_failed")
             # _drop_child's identity check also makes this a no-op when the
             # child's own reader task already dropped (and redistributed) it.
+            # Its repair replays the forward log (this message included) to
+            # the re-adopted grandchildren, so the fan-out that exposed the
+            # death costs the orphan subtree nothing.
             await self._drop_child(cid, c)
 
     # -- join walk (client side) ---------------------------------------------
@@ -430,7 +556,9 @@ class _TreeNode:
             if cand == s.remote_peer:
                 return s  # the sender admitted me: reuse this stream
             try:
-                cs = await self.host.new_stream(cand, self.protoid)
+                # Two attempts per candidate: the walk itself is the outer
+                # retry (next candidate), so per-hop budget stays small.
+                cs = await self.dial_retry(cand, cls="join", max_attempts=2)
                 await cs.write_message(Message(type=MessageType.JOIN))
                 w2 = await cs.read_message()
                 if w2.type != MessageType.UPDATE:
@@ -491,7 +619,9 @@ class LiveTopic:
         self.tm = tm
         self.title = title
         self.protoid = f"{tm.host.id}/{title}"  # (root, title) namespacing
-        self.node = _TreeNode(tm.host, self.protoid, opts, metrics=tm.registry)
+        self.node = _TreeNode(
+            tm.host, self.protoid, opts, metrics=tm.registry, retry=tm.retry
+        )
         # Publisher identity: with a seed, every publish travels as a signed
         # Envelope (crypto/pipeline) inside the Data frame — the fix for the
         # reference's `// TODO: add signature` (pubsub.go:117).
@@ -565,6 +695,7 @@ class LiveSubscription:
             TreeOpts(),
             repair_timeout_s=repair_timeout_s,
             metrics=tm.registry,
+            retry=tm.retry,
         )
         self.node.root_id = root_id
         # client.out, cap 16 (client.go:79): a full queue blocks the receive
@@ -577,11 +708,20 @@ class LiveSubscription:
         self.validator = (
             _BatchValidator(self, title, validate) if validate else None
         )
+        # Replay dedup for the unsigned plane: payload digests of recently
+        # seen Data frames.  A frame carrying the wire ``replay`` flag whose
+        # payload is here already arrived through the dead parent before it
+        # died — drop it (no deliver, no relay).  Unflagged duplicates are
+        # legitimate application traffic and always flow.  (The signed plane
+        # needs none of this: the monotonic-seqno guard already drops
+        # re-delivered envelopes.)
+        self._seen_data: set = set()
+        self._seen_order: deque = deque()
 
     async def start(self) -> None:
         """The Subscribe flow (``client.go:65-94``)."""
         host = self.tm.host
-        s = await host.new_stream(self.node.root_id, self.protoid)
+        s = await self.node.dial_retry(self.node.root_id, cls="dial")
         host.set_stream_handler(self.protoid, self._stream_handler)
         self.node.parent_stream = await self.node.join_to_peer(s)
         await self.node.notify_parent_state()
@@ -620,8 +760,10 @@ class LiveSubscription:
                     return
                 node.parent_stream = None
                 try:
-                    node.parent_stream = await asyncio.wait_for(
-                        node.pause.get(), timeout=node.repair_timeout_s
+                    # Typed wait: a timeout lands in the registry as
+                    # live.retry.repair.timeout before the rejoin fallback.
+                    node.parent_stream = await node.retry.wait_for(
+                        node.pause.get(), node.repair_timeout_s, cls="repair"
                     )
                 except asyncio.TimeoutError:
                     if not await self._rejoin_root():
@@ -642,6 +784,13 @@ class LiveSubscription:
                     # relays (in arrival order) only what verifies.
                     await self.validator.submit(m)
                     continue
+                h = hashlib.sha256(m.data).digest()
+                if m.replay and h in self._seen_data:
+                    continue  # repair replay of an already-delivered frame
+                self._seen_data.add(h)
+                self._seen_order.append(h)
+                if len(self._seen_order) > SEEN_DATA_CAP:
+                    self._seen_data.discard(self._seen_order.popleft())
                 await self.out.put(m.data)        # deliver (client.go:124-127)
                 await node.forward_message(m)     # then relay (client.go:130)
             elif m.type == MessageType.UPDATE:
@@ -649,17 +798,28 @@ class LiveSubscription:
                 continue
 
     async def _rejoin_root(self) -> bool:
-        """``rejoinRoot`` — implemented (vs ``panic``, ``client.go:96-98``)."""
+        """``rejoinRoot`` — implemented (vs ``panic``, ``client.go:96-98``).
+
+        The whole dial+walk runs under the retry policy with the repair
+        timeout as its deadline: a transiently unreachable root costs
+        backoff, not the subscription (the reference-shaped single attempt
+        gave up on the first refused dial)."""
         self.node._inc("live.rejoin_root")
         _log.info(
             "rejoin_root",
             extra=kv(peer=self.tm.host.id, root=self.node.root_id),
         )
-        try:
+
+        async def _attempt() -> Stream:
             s = await self.tm.host.new_stream(self.node.root_id, self.protoid)
-            self.node.parent_stream = await self.node.join_to_peer(s)
+            return await self.node.join_to_peer(s)
+
+        try:
+            self.node.parent_stream = await self.node.retry.run(
+                "rejoin", _attempt, deadline_s=self.node.repair_timeout_s
+            )
             return True
-        except (StreamClosed, KeyError):
+        except (StreamClosed, KeyError, OSError, asyncio.TimeoutError):
             self.node.closed = True
             return False
 
@@ -687,10 +847,14 @@ class LiveTopicManager:
         host: LiveHost,
         repair_timeout_s: float = SUB_REPAIR_TIMEOUT_S,
         registry: Optional[MetricsRegistry] = None,
+        retry_opts: Optional[RetryOpts] = None,
     ):
         self.host = host
         self.repair_timeout_s = repair_timeout_s
         self.registry = registry
+        # One policy per host: breaker state is this host's view of each
+        # operation class (dial/join/adopt/rejoin).
+        self.retry = RetryPolicy(retry_opts, registry=registry)
         self.topics: Dict[str, LiveTopic] = {}
         self.subscriptions: List[LiveSubscription] = []
 
@@ -843,9 +1007,16 @@ class LiveNetwork:
         self,
         repair_timeout_s: float = SUB_REPAIR_TIMEOUT_S,
         validate_ids: bool = False,
+        chaos=None,
+        retry_opts: Optional[RetryOpts] = None,
     ):
         self.peerstore = Peerstore(validate_ids=validate_ids)
         self.repair_timeout_s = repair_timeout_s
+        # Optional net.chaos.ChaosTransport shared by every host, so a
+        # (src, dst, proto) link's fault stream is network-global; None
+        # leaves every stream un-wrapped (the zero-overhead clean path).
+        self.chaos = chaos
+        self.retry_opts = retry_opts
         self.registry = MetricsRegistry()
         self._sync_hosts: List["SyncHost"] = []
         self._metrics_server: Optional[MetricsHTTPServer] = None
@@ -855,7 +1026,20 @@ class LiveNetwork:
         self._counter = 0
 
     def call(self, coro, timeout: float = 30.0):
-        return asyncio.run_coroutine_threadsafe(coro, self._loop).result(timeout)
+        fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
+        try:
+            return fut.result(timeout)
+        except (concurrent.futures.TimeoutError, asyncio.TimeoutError):
+            if fut.done():
+                # The coroutine itself raised a TimeoutError (e.g. an inner
+                # wait_for): that is its result, not a stuck call.
+                raise
+            # The CALL outlived its deadline: cancel the orphaned coroutine
+            # and name it in the failure (the satellite contract — a bare
+            # concurrent.futures.TimeoutError says nothing about what hung).
+            fut.cancel()
+            name = getattr(coro, "__qualname__", None) or repr(coro)
+            raise LiveCallTimeout(name, timeout) from None
 
     def serve_metrics(self, bind: str = "127.0.0.1") -> Tuple[str, int]:
         """Start the ``/metrics`` + ``/debug/tree`` endpoint; return (host, port).
@@ -885,7 +1069,7 @@ class LiveNetwork:
         else:
             peer_id = f"livepeer-{self._counter}"
         self._counter += 1
-        h = LiveHost(peer_id, self.peerstore)
+        h = LiveHost(peer_id, self.peerstore, chaos=self.chaos)
         self.call(h.start())
         return SyncHost(self, h)
 
@@ -911,7 +1095,8 @@ class SyncHost:
         self.live = host
         self.id = host.id
         self.tm = LiveTopicManager(
-            host, repair_timeout_s=net.repair_timeout_s, registry=net.registry
+            host, repair_timeout_s=net.repair_timeout_s, registry=net.registry,
+            retry_opts=net.retry_opts,
         )
         net._sync_hosts.append(self)
 

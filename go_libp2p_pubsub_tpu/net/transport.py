@@ -72,7 +72,17 @@ class Peerstore:
         try:
             return self._addrs[peer_id]
         except KeyError:
-            raise KeyError(f"no address registered for peer {peer_id!r}")
+            # Name who IS registered (capped at 10): a failed redirect dial
+            # is usually a peerstore wiring bug, and the candidate list makes
+            # it diagnosable from the message alone.
+            known = sorted(self._addrs)
+            shown = ", ".join(repr(p) for p in known[:10])
+            if len(known) > 10:
+                shown += f", ... +{len(known) - 10} more"
+            raise KeyError(
+                f"no address registered for peer {peer_id!r}; "
+                f"known peers: [{shown}]"
+            ) from None
 
     def known(self) -> Dict[str, Tuple[str, int]]:
         return dict(self._addrs)
@@ -179,9 +189,18 @@ class LiveHost:
     transport-level mirror of libp2p's per-protocol stream routing.
     """
 
-    def __init__(self, peer_id: str, peerstore: Peerstore, bind: str = "127.0.0.1"):
+    def __init__(
+        self,
+        peer_id: str,
+        peerstore: Peerstore,
+        bind: str = "127.0.0.1",
+        chaos=None,
+    ):
         self.id = peer_id
         self.peerstore = peerstore
+        # Optional fault injector (net/chaos.ChaosTransport): None keeps
+        # every stream un-wrapped — the clean path has zero chaos cost.
+        self.chaos = chaos
         self._bind = bind
         self._server: Optional[asyncio.AbstractServer] = None
         self._handlers: Dict[str, StreamHandler] = {}
@@ -227,6 +246,10 @@ class LiveHost:
         """Dial a peer for a protocol (``h.NewStream``, ``subtree.go:257``)."""
         if self.closed:
             raise StreamClosed(f"host {self.id} is closed")
+        if self.chaos is not None and not self.chaos.allow_dial(
+            self.id, peer_id, protoid
+        ):
+            raise StreamClosed(f"dial {peer_id} blackholed (chaos)")
         host, port = self.peerstore.addr(peer_id)
         try:
             reader, writer = await asyncio.open_connection(host, port)
@@ -241,6 +264,8 @@ class LiveHost:
             on_close=self._streams.discard,
         )
         self._streams.add(s)
+        if self.chaos is not None:
+            return self.chaos.wrap(s, self.id, spawn=self.spawn)
         return s
 
     def spawn(self, coro) -> asyncio.Task:
@@ -281,4 +306,9 @@ class LiveHost:
             on_close=self._streams.discard,
         )
         self._streams.add(s)
+        if self.chaos is not None:
+            # Egress faults are symmetric: the acceptor's writes back to the
+            # dialer run under the (acceptor, dialer, proto) link policy.
+            self.spawn(handler(self.chaos.wrap(s, self.id, spawn=self.spawn)))
+            return
         self.spawn(handler(s))

@@ -3,12 +3,19 @@
 - :mod:`.spec` — the declarative layer (:class:`ScenarioSpec` + JSON).
 - :mod:`.compiler` — lowering to ``ops.schedule`` event tensors.
 - :mod:`.runner` — execution, traces, bit-for-bit replay.
+- :mod:`.live_runner` — the same campaigns over real sockets + chaos.
 - :mod:`.slo` — verdicts graded from the flight record.
 - :mod:`.canon` — the named, committed campaign suite.
 """
 
 from .canon import CANON, build, build_all
 from .compiler import CompiledScenario, compile_scenario
+from .live_runner import (
+    LivePlaneError,
+    LiveScenarioResult,
+    live_supported,
+    run_live_scenario,
+)
 from .runner import (
     ScenarioResult,
     replay_trace,
@@ -34,6 +41,8 @@ __all__ = [
     "CompiledScenario",
     "Criterion",
     "LinkWindow",
+    "LivePlaneError",
+    "LiveScenarioResult",
     "SLO",
     "ScenarioResult",
     "ScenarioSpec",
@@ -43,7 +52,9 @@ __all__ = [
     "build_all",
     "compile_scenario",
     "evaluate",
+    "live_supported",
     "replay_trace",
+    "run_live_scenario",
     "run_scenario",
     "run_suite",
     "save_trace",

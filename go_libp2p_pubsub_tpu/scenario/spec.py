@@ -192,6 +192,11 @@ class ScenarioSpec:
     # "leaves": {step: [ids]}} — the compiler lowers them alongside churn
     # (see ScenarioSpec.from_fault_plan / compiler._lower_faults).
     faults: Optional[Dict[str, Dict[str, List[int]]]] = None
+    # Live-plane overrides for scenario.live_runner (ignored by the sim
+    # compiler): {"n_hosts": int, "step_ms": float}.  None = the runner's
+    # defaults — keeping this a plain optional dict preserves the exact
+    # JSON round-trip for specs that never touch the live plane.
+    live: Optional[Dict[str, Any]] = None
     slo: SLO = field(default_factory=SLO)
     description: str = ""
 

@@ -145,6 +145,12 @@ class MetricsRegistry:
     def counters(self) -> Dict[str, float]:
         return dict(self._counters)
 
+    def counter(self, name: str, default: float = 0.0) -> float:
+        """One counter's current value (``default`` if never incremented) —
+        the read side the retry/breaker tests and the live scenario runner
+        use to assert on transition counts."""
+        return self._counters.get(name, default)
+
     def latest(self, name: str) -> Optional[float]:
         s = self._series.get(name)
         return s[-1][1] if s else None

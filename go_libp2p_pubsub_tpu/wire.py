@@ -64,6 +64,12 @@ class Message:
     tree_width: int = 0
     tree_max_width: int = 0
     num_peers: int = 0
+    # Repair-replay marker (this build's extension, net/live.py): a Data
+    # frame re-sent to a re-adopted orphan because the adopter cannot know
+    # what the dead parent delivered.  Serialized only when set, so normal
+    # traffic stays byte-identical to the reference encoder; a Go peer's
+    # ``encoding/json`` ignores the unknown key on the frames that carry it.
+    replay: bool = False
 
     def to_json_obj(self) -> dict:
         # Field order matches the Go struct declaration order so encoded bytes
@@ -79,6 +85,8 @@ class Message:
             obj["treemaxwidth"] = self.tree_max_width
         if self.num_peers:
             obj["numpeers"] = self.num_peers
+        if self.replay:
+            obj["replay"] = True
         return obj
 
     @classmethod
@@ -91,6 +99,7 @@ class Message:
             tree_width=int(obj.get("treewidth", 0)),
             tree_max_width=int(obj.get("treemaxwidth", 0)),
             num_peers=int(obj.get("numpeers", 0)),
+            replay=bool(obj.get("replay", False)),
         )
 
 
@@ -146,6 +155,13 @@ class MessageDecoder:
         except json.JSONDecodeError:
             # Incomplete object: keep buffering.  A syntactically corrupt
             # stream surfaces as an ever-growing buffer; callers bound it.
+            self._buf = s
+            return None
+        except RecursionError:
+            # Pathological nesting (e.g. a "[[[[..." flood) blows the
+            # scanner's stack long before any object completes.  Treat it
+            # like an incomplete object: buffer, and let the caller's
+            # pending-bytes bound abort the stream.
             self._buf = s
             return None
         self._buf = s[end:]

@@ -1,0 +1,590 @@
+"""Chaos transport + retry policy suite.
+
+Fast section: pure-unit coverage of the fault-injection layer
+(``net/chaos.py``), the retry/backoff/breaker policy (``net/policy.py``),
+the transport's defensive guards (oversized/corrupt streams, unknown-peer
+diagnostics), and the wire ``replay`` extension — including the golden
+determinism trace the chaos layer's seeding contract is pinned by.
+
+Slow section (``@pytest.mark.slow``): the same faults exercised over real
+sockets, plus the scenario live-runner smoke and the 16-host canon
+acceptance runs (``degraded_links`` / ``churn_10pct`` graded by the same
+SLO thresholds as the sim plane).
+"""
+
+import asyncio
+import random
+import time
+
+import pytest
+
+from go_libp2p_pubsub_tpu import scenario
+from go_libp2p_pubsub_tpu.config import RetryOpts
+from go_libp2p_pubsub_tpu.net import LiveNetwork
+from go_libp2p_pubsub_tpu.net.chaos import (
+    ChaosTransport,
+    LinkPolicy,
+    LinkPolicyTable,
+)
+from go_libp2p_pubsub_tpu.net.policy import (
+    CircuitBreaker,
+    CircuitOpen,
+    LiveCallTimeout,
+    RetryPolicy,
+)
+from go_libp2p_pubsub_tpu.net.transport import (
+    MAX_PENDING_BYTES,
+    Peerstore,
+    Stream,
+    StreamClosed,
+)
+from go_libp2p_pubsub_tpu.utils.metrics import MetricsRegistry
+from go_libp2p_pubsub_tpu.wire import Message, MessageType, encode_message
+
+
+# ---------------------------------------------------------------------------
+# LinkPolicy / LinkPolicyTable
+# ---------------------------------------------------------------------------
+
+
+class TestLinkPolicy:
+    def test_noop_default(self):
+        assert LinkPolicy().is_noop()
+        assert not LinkPolicy(delay_s=0.01).is_noop()
+        assert not LinkPolicy(blackhole=True).is_noop()
+
+    @pytest.mark.parametrize("kw", [
+        {"drop_prob": 1.5},
+        {"drop_prob": -0.1},
+        {"duplicate_prob": 2.0},
+        {"reorder_prob": -1.0},
+        {"reset_prob": 1.01},
+        {"delay_s": -0.5},
+        {"jitter_s": -1e-9},
+        {"bandwidth_bytes_per_s": -1.0},
+        {"reset_after_msgs": -1},
+    ])
+    def test_validation(self, kw):
+        with pytest.raises(ValueError):
+            LinkPolicy(**kw)
+
+
+class TestLinkPolicyTable:
+    def test_empty_resolves_none(self):
+        assert LinkPolicyTable().policy_for("a", "b", "/p") is None
+
+    def test_wildcard_and_specificity(self):
+        t = LinkPolicyTable()
+        broad = LinkPolicy(delay_s=0.1)
+        narrow = LinkPolicy(drop_prob=0.5)
+        t.set(broad)
+        t.set(narrow, src="a")
+        assert t.policy_for("a", "b", "/p") is narrow
+        assert t.policy_for("x", "b", "/p") is broad
+
+    def test_later_entry_breaks_ties(self):
+        t = LinkPolicyTable()
+        first, second = LinkPolicy(delay_s=0.1), LinkPolicy(delay_s=0.2)
+        t.set(first)
+        t.set(second)
+        assert t.policy_for("a", "b", "/p") is second
+
+    def test_glob_patterns(self):
+        t = LinkPolicyTable()
+        pol = LinkPolicy(delay_s=0.1)
+        t.set(pol, dst="livepeer-*")
+        assert t.policy_for("x", "livepeer-7", "/p") is pol
+        assert t.policy_for("x", "other", "/p") is None
+
+    def test_remove_exact_triple(self):
+        t = LinkPolicyTable()
+        broad = LinkPolicy(delay_s=0.1)
+        override = LinkPolicy(drop_prob=1.0)
+        t.set(broad)
+        t.set(override, dst="h1")
+        assert t.policy_for("a", "h1", "/p") is override
+        # Removing the override restores the shadowed baseline.
+        assert t.remove(dst="h1") == 1
+        assert t.policy_for("a", "h1", "/p") is broad
+        # A second remove of the same pattern is a no-op, not an error.
+        assert t.remove(dst="h1") == 0
+
+    def test_clear(self):
+        t = LinkPolicyTable()
+        t.set(LinkPolicy(delay_s=0.1))
+        t.clear()
+        assert t.policy_for("a", "b", "/p") is None
+
+
+# ---------------------------------------------------------------------------
+# ChaosTransport determinism
+# ---------------------------------------------------------------------------
+
+_GOLDEN_POLICY = LinkPolicy(
+    drop_prob=0.3, duplicate_prob=0.2, reorder_prob=0.2,
+    reorder_extra_s=0.004, delay_s=0.001, jitter_s=0.002, reset_prob=0.05,
+)
+_GOLDEN_LINK = ("a", "b", "/x/1.0")
+
+# 20 decisions on seed 42 — regenerate ONLY on a deliberate change to the
+# draw order documented in ``ChaosTransport.decide``.  ``random.Random`` is
+# stable across Python versions, so this literal is platform-independent.
+_GOLDEN_TRACE = [
+    ("drop", 0), ("delay", 1, 1186), ("delay", 2, 1742), ("delay", 3, 2579),
+    ("drop", 4), ("reorder", 5), ("delay", 5, 5660), ("reorder", 6),
+    ("delay", 6, 5402), ("reorder", 7), ("delay", 7, 6117), ("drop", 8),
+    ("drop", 9), ("dup", 10), ("delay", 10, 1212), ("delay", 11, 1019),
+    ("drop", 12), ("delay", 13, 1941), ("delay", 14, 1961),
+    ("delay", 15, 1373), ("dup", 16), ("reorder", 16), ("delay", 16, 6870),
+    ("delay", 17, 2760), ("drop", 18), ("delay", 19, 1602),
+]
+
+
+class TestChaosDeterminism:
+    def test_golden_trace(self):
+        ct = ChaosTransport(seed=42)
+        for _ in range(20):
+            ct.decide(_GOLDEN_LINK, _GOLDEN_POLICY, 100)
+        assert ct.trace(_GOLDEN_LINK) == _GOLDEN_TRACE
+
+    def test_seed_changes_trace(self):
+        ct = ChaosTransport(seed=43)
+        for _ in range(20):
+            ct.decide(_GOLDEN_LINK, _GOLDEN_POLICY, 100)
+        assert ct.trace(_GOLDEN_LINK) != _GOLDEN_TRACE
+
+    def test_links_are_independent(self):
+        # The per-link decision stream must not depend on how draws on
+        # OTHER links interleave with it.
+        la, lb = ("a", "b", "/p"), ("a", "c", "/p")
+        ct1 = ChaosTransport(seed=7)
+        for _ in range(10):  # interleaved
+            ct1.decide(la, _GOLDEN_POLICY, 64)
+            ct1.decide(lb, _GOLDEN_POLICY, 64)
+        ct2 = ChaosTransport(seed=7)
+        for _ in range(10):  # sequential
+            ct2.decide(la, _GOLDEN_POLICY, 64)
+        for _ in range(10):
+            ct2.decide(lb, _GOLDEN_POLICY, 64)
+        assert ct1.trace(la) == ct2.trace(la)
+        assert ct1.trace(lb) == ct2.trace(lb)
+
+    def test_reset_after_msgs_fires_once(self):
+        ct = ChaosTransport(seed=0)
+        pol = LinkPolicy(reset_after_msgs=3)
+        link = ("a", "b", "/p")
+        decisions = [ct.decide(link, pol, 10) for _ in range(6)]
+        assert [d.reset for d in decisions] == [
+            False, False, True, False, False, False
+        ]
+        assert ct.trace(link) == [("reset", 2)]
+
+    def test_bandwidth_serialization_time(self):
+        ct = ChaosTransport(seed=0)
+        d = ct.decide(("a", "b", "/p"),
+                      LinkPolicy(bandwidth_bytes_per_s=1000.0), 500)
+        assert d.ser_s == pytest.approx(0.5)
+
+    def test_blackhole_dial(self):
+        ct = ChaosTransport(seed=0)
+        ct.table.set(LinkPolicy(blackhole=True), dst="b")
+        assert not ct.allow_dial("a", "b", "/p")
+        assert ct.allow_dial("a", "c", "/p")
+        assert ct.trace(("a", "b", "/p")) == [("blackhole_dial",)]
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy / CircuitBreaker
+# ---------------------------------------------------------------------------
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _fake_policy(opts, registry=None, seed=7):
+    clock = _FakeClock()
+    sleeps = []
+
+    async def sleep(d):
+        sleeps.append(d)
+        clock.t += d
+
+    pol = RetryPolicy(opts=opts, registry=registry,
+                      rng=random.Random(seed), clock=clock, sleep=sleep)
+    return pol, clock, sleeps
+
+
+class TestRetryPolicy:
+    def test_backoff_delays_golden(self):
+        pol = RetryPolicy(opts=RetryOpts(max_attempts=6),
+                          rng=random.Random(7))
+        delays = [round(d, 6) for d in pol.backoff_delays()]
+        assert delays == [0.082383, 0.07974, 0.17317, 0.084009, 0.158263]
+        # Every delay obeys the decorrelated-jitter bounds.
+        assert all(0.05 <= d <= 2.0 for d in delays)
+
+    def test_success_first_attempt(self):
+        reg = MetricsRegistry()
+        pol, _, sleeps = _fake_policy(RetryOpts(), registry=reg)
+
+        async def fn():
+            return "ok"
+
+        assert asyncio.run(pol.run("dial", fn)) == "ok"
+        assert sleeps == []  # clean path never sleeps
+        assert reg.counter("live.retry.dial.attempt") == 1
+        assert reg.counter("live.retry.dial.success") == 1
+        assert reg.counter("live.retry.dial.retry") == 0
+
+    def test_retries_then_succeeds(self):
+        reg = MetricsRegistry()
+        pol, _, sleeps = _fake_policy(RetryOpts(max_attempts=5), registry=reg)
+        calls = []
+
+        async def fn():
+            calls.append(1)
+            if len(calls) < 3:
+                raise StreamClosed("dial failed")
+            return "ok"
+
+        assert asyncio.run(pol.run("dial", fn)) == "ok"
+        assert len(calls) == 3
+        assert len(sleeps) == 2
+        assert reg.counter("live.retry.dial.attempt") == 3
+        assert reg.counter("live.retry.dial.retry") == 2
+        assert reg.counter("live.retry.dial.success") == 1
+
+    def test_exhausted_raises_last_failure(self):
+        reg = MetricsRegistry()
+        pol, _, _ = _fake_policy(RetryOpts(max_attempts=3), registry=reg)
+
+        async def fn():
+            raise StreamClosed("always down")
+
+        with pytest.raises(StreamClosed, match="always down"):
+            asyncio.run(pol.run("join", fn))
+        assert reg.counter("live.retry.join.attempt") == 3
+        assert reg.counter("live.retry.join.exhausted") == 1
+
+    def test_non_retryable_propagates_immediately(self):
+        reg = MetricsRegistry()
+        pol, _, _ = _fake_policy(RetryOpts(max_attempts=5), registry=reg)
+
+        async def fn():
+            raise RuntimeError("logic bug")
+
+        with pytest.raises(RuntimeError):
+            asyncio.run(pol.run("dial", fn))
+        assert reg.counter("live.retry.dial.attempt") == 1
+        assert reg.counter("live.retry.dial.retry") == 0
+
+    def test_deadline_stops_retry_loop(self):
+        reg = MetricsRegistry()
+        opts = RetryOpts(max_attempts=10, base_delay_s=5.0,
+                         max_delay_s=5.0, deadline_s=1.0)
+        pol, clock, _ = _fake_policy(opts, registry=reg)
+        calls = []
+
+        async def fn():
+            calls.append(1)
+            raise StreamClosed("down")
+
+        with pytest.raises(StreamClosed):
+            asyncio.run(pol.run("adopt", fn))
+        # The first backoff is clipped to the remaining deadline, after
+        # which the loop stops — nowhere near the 10-attempt budget.
+        assert len(calls) < 3
+        assert clock.t <= opts.deadline_s + 1e-9
+        assert reg.counter("live.retry.adopt.exhausted") == 1
+
+    def test_wait_for_counts_timeouts(self):
+        reg = MetricsRegistry()
+        pol = RetryPolicy(opts=RetryOpts(), registry=reg)
+
+        async def go():
+            await pol.wait_for(asyncio.sleep(5), timeout_s=0.01, cls="repair")
+
+        with pytest.raises(asyncio.TimeoutError):
+            asyncio.run(go())
+        assert reg.counter("live.retry.repair.timeout") == 1
+
+
+class TestCircuitBreaker:
+    def test_transitions(self):
+        clock = _FakeClock()
+        reg = MetricsRegistry()
+        br = CircuitBreaker("dial", failures_to_open=3, reset_s=10.0,
+                            registry=reg, clock=clock)
+        assert br.allow()
+        for _ in range(3):
+            br.record_failure()
+        assert br.state == CircuitBreaker.OPEN
+        assert not br.allow()  # fast-fail inside the cooldown
+        assert reg.counter("live.breaker.dial.fastfail") == 1
+        clock.t = 10.0
+        assert br.allow()  # the half-open probe
+        assert br.state == CircuitBreaker.HALF_OPEN
+        br.record_failure()  # probe fails -> re-open immediately
+        assert br.state == CircuitBreaker.OPEN
+        clock.t = 20.0
+        assert br.allow()
+        br.record_success()  # probe succeeds -> closed
+        assert br.state == CircuitBreaker.CLOSED
+        assert reg.counter("live.breaker.dial.opened") == 2
+        assert reg.counter("live.breaker.dial.closed") == 1
+
+    def test_policy_fast_fails_when_open(self):
+        reg = MetricsRegistry()
+        opts = RetryOpts(max_attempts=1, breaker_failures=2)
+        pol, _, _ = _fake_policy(opts, registry=reg)
+
+        async def fn():
+            raise StreamClosed("down")
+
+        for _ in range(2):
+            with pytest.raises(StreamClosed):
+                asyncio.run(pol.run("dial", fn))
+
+        async def never(_="unreached"):
+            raise AssertionError("breaker must fast-fail before the call")
+
+        with pytest.raises(CircuitOpen):
+            asyncio.run(pol.run("dial", never))
+        # CircuitOpen IS a StreamClosed: existing handlers need no changes.
+        assert issubclass(CircuitOpen, StreamClosed)
+        assert reg.counter("live.breaker.dial.fastfail") == 1
+
+
+# ---------------------------------------------------------------------------
+# Transport guards
+# ---------------------------------------------------------------------------
+
+
+class _NullWriter:
+    """Just enough writer surface for Stream.close/abort in unit tests."""
+
+    class _T:
+        def abort(self):
+            pass
+
+    def __init__(self):
+        self.transport = self._T()
+
+    def write(self, data):
+        pass
+
+    async def drain(self):
+        pass
+
+    def close(self):
+        pass
+
+
+class TestStreamGuards:
+    @pytest.mark.parametrize("flood", [
+        b'"' + b"a" * (MAX_PENDING_BYTES + 2),  # unterminated string
+        b"[" * (MAX_PENDING_BYTES + 2),         # scanner-breaking nesting
+    ], ids=["unterminated", "deep-nesting"])
+    def test_oversized_corrupt_stream_aborts(self, flood):
+        async def go():
+            reader = asyncio.StreamReader()
+            s = Stream(reader, _NullWriter(), "peer", "/t/1.0")
+            # Syntactically incomplete JSON forever: the decoder buffers
+            # until the MAX_PENDING_BYTES bound trips.
+            reader.feed_data(flood)
+            reader.feed_eof()
+            with pytest.raises(StreamClosed, match="oversized"):
+                await s.read_message()
+            assert s.closed
+
+        asyncio.run(go())
+
+    def test_invalid_utf8_aborts(self):
+        async def go():
+            reader = asyncio.StreamReader()
+            s = Stream(reader, _NullWriter(), "peer", "/t/1.0")
+            reader.feed_data(b"\xff\xff")
+            reader.feed_eof()
+            with pytest.raises(StreamClosed, match="invalid UTF-8"):
+                await s.read_message()
+
+        asyncio.run(go())
+
+
+class TestPeerstoreDiagnostics:
+    def test_unknown_peer_names_known_ids(self):
+        ps = Peerstore()
+        for i in range(3):
+            ps.add(f"peer-{i}", "127.0.0.1", 4000 + i)
+        with pytest.raises(KeyError) as ei:
+            ps.addr("ghost")
+        msg = str(ei.value)
+        assert "ghost" in msg
+        for i in range(3):
+            assert f"peer-{i}" in msg
+
+    def test_known_id_list_truncates_at_ten(self):
+        ps = Peerstore()
+        for i in range(14):
+            ps.add(f"p{i:02d}", "127.0.0.1", 4000 + i)
+        with pytest.raises(KeyError) as ei:
+            ps.addr("ghost")
+        msg = str(ei.value)
+        assert "+4 more" in msg
+        assert msg.count("p0") + msg.count("p1") <= 12  # capped listing
+
+
+class TestLiveCallTimeout:
+    def test_names_the_stuck_coroutine(self):
+        net = LiveNetwork()
+        try:
+            with pytest.raises(LiveCallTimeout) as ei:
+                net.call(asyncio.sleep(30), timeout=0.1)
+            assert ei.value.coro_name == "sleep"
+            assert ei.value.timeout_s == 0.1
+            assert "sleep" in str(ei.value)
+            assert isinstance(ei.value, TimeoutError)
+        finally:
+            net.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Wire replay extension
+# ---------------------------------------------------------------------------
+
+
+class TestWireReplayFlag:
+    def test_round_trip(self):
+        m = Message(type=MessageType.DATA, data=b"payload", replay=True)
+        out = Message.from_json_obj(m.to_json_obj())
+        assert out.replay and out.data == b"payload"
+
+    def test_absent_by_default(self):
+        # Normal frames stay byte-identical to the reference encoder.
+        enc = encode_message(Message(type=MessageType.DATA, data=b"x"))
+        assert b"replay" not in enc
+        assert not Message.from_json_obj({"Type": 0}).replay
+
+
+# ---------------------------------------------------------------------------
+# Socket-level chaos (slow)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def chaos_net():
+    chaos = ChaosTransport(seed=7)
+    n = LiveNetwork(repair_timeout_s=2.0, chaos=chaos)
+    yield n, chaos
+    n.shutdown()
+
+
+def _two_subscribers(net):
+    hosts = net.make_hosts(3)
+    topic = hosts[0].new_topic("chaos")
+    subs = [h.subscribe(hosts[0].id, "chaos") for h in hosts[1:]]
+    time.sleep(0.2)
+    return hosts, topic, subs
+
+
+@pytest.mark.slow
+class TestChaosOverSockets:
+    def test_delayed_link_still_delivers(self, chaos_net):
+        net, chaos = chaos_net
+        hosts, topic, subs = _two_subscribers(net)
+        chaos.table.set(LinkPolicy(delay_s=0.25), dst=hosts[1].id)
+        t0 = time.monotonic()
+        topic.publish_message(b"slow boat")
+        assert subs[0].get(timeout=5.0) == b"slow boat"
+        assert time.monotonic() - t0 >= 0.2
+        # The undelayed sibling is unaffected.
+        assert subs[1].get(timeout=5.0) == b"slow boat"
+
+    def test_dropped_link_loses_then_recovers(self, chaos_net):
+        net, chaos = chaos_net
+        hosts, topic, subs = _two_subscribers(net)
+        chaos.table.set(LinkPolicy(drop_prob=1.0), dst=hosts[1].id)
+        topic.publish_message(b"into the void")
+        assert subs[1].get(timeout=5.0) == b"into the void"
+        with pytest.raises(asyncio.TimeoutError):
+            subs[0].get(timeout=0.8)
+        # Window closes -> the link carries traffic again.
+        chaos.table.remove(dst=hosts[1].id)
+        topic.publish_message(b"back online")
+        assert subs[0].get(timeout=5.0) == b"back online"
+
+    def test_duplicating_link_delivers_both_copies(self, chaos_net):
+        net, chaos = chaos_net
+        hosts, topic, subs = _two_subscribers(net)
+        chaos.table.set(LinkPolicy(duplicate_prob=1.0), dst=hosts[1].id)
+        topic.publish_message(b"echo")
+        # Unflagged duplicates are legitimate traffic and must flow (only
+        # repair REPLAYS are deduplicated at delivery).
+        assert subs[0].get(timeout=5.0) == b"echo"
+        assert subs[0].get(timeout=5.0) == b"echo"
+
+    def test_blackholed_dial_fails_fast(self, chaos_net):
+        net, chaos = chaos_net
+        hosts = net.make_hosts(2)
+        chaos.table.set(LinkPolicy(blackhole=True), dst=hosts[1].id)
+        with pytest.raises(StreamClosed, match="blackholed"):
+            net.call(hosts[0].live.new_stream(hosts[1].id, "/chaos/test"))
+
+    def test_reset_link_triggers_repair_and_rejoin(self, chaos_net):
+        net, chaos = chaos_net
+        hosts, topic, subs = _two_subscribers(net)
+        # The first chaos-decided message on the root->child link aborts the
+        # connection; the child must detect, repair, and rejoin.
+        chaos.table.set(LinkPolicy(reset_after_msgs=1), dst=hosts[1].id)
+        topic.publish_message(b"rst")
+        assert subs[1].get(timeout=5.0) == b"rst"
+        deadline = time.monotonic() + 15.0
+        got = None
+        while time.monotonic() < deadline:
+            topic.publish_message(b"after-reset")
+            try:
+                got = subs[0].get(timeout=0.4)
+                break
+            except (TimeoutError, asyncio.TimeoutError):
+                continue
+        assert got == b"after-reset"
+
+
+# ---------------------------------------------------------------------------
+# Scenario live plane (slow)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestLiveScenarios:
+    def test_unsupported_spec_rejected(self):
+        unsupported = [s for s in scenario.build_all(None)
+                       if not scenario.live_supported(s)]
+        if not unsupported:
+            pytest.skip("whole canon is live-lowerable")
+        with pytest.raises(ValueError):
+            scenario.run_live_scenario(unsupported[0])
+
+    def test_smoke_small_tree(self):
+        spec = scenario.build("degraded_links")
+        res = scenario.run_live_scenario(spec, n_hosts=4, step_s=0.04)
+        assert res.n_publishes > 0
+        assert res.record["delivery_frac"].shape[0] == spec.n_steps
+        assert res.verdict.criteria  # graded by the same SLO canon
+
+    def test_acceptance_degraded_links_16_hosts(self):
+        spec = scenario.build("degraded_links")
+        res = scenario.run_live_scenario(spec, n_hosts=16)
+        assert res.record["delivery_frac"][-1] >= 0.99
+        assert res.verdict.passed, res.verdict.to_dict()
+
+    def test_acceptance_churn_10pct_16_hosts(self):
+        spec = scenario.build("churn_10pct")
+        res = scenario.run_live_scenario(spec, n_hosts=16)
+        assert res.record["delivery_frac"][-1] >= 0.99
+        assert res.verdict.passed, res.verdict.to_dict()

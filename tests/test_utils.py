@@ -78,6 +78,12 @@ class TestCheckpoint:
         with pytest.raises(ValueError, match="leaf"):
             checkpoint.restore(p, wrong)
 
+    def test_dtype_mismatch_rejected(self, tmp_path):
+        p = str(tmp_path / "t.ckpt")
+        checkpoint.save(p, {"x": jnp.zeros(4, jnp.int32)})
+        with pytest.raises(ValueError, match="int32"):
+            checkpoint.restore(p, {"x": jnp.zeros(4, jnp.float32)})
+
     def test_structure_mismatch_rejected(self, tmp_path):
         st = small_tree(8)
         p = str(tmp_path / "t.ckpt")

@@ -10,10 +10,16 @@ Usage::
     python tools/scenario_run.py steady_state --save-trace trace.json
     python tools/scenario_run.py --replay trace.json  # bit-for-bit check
     python tools/scenario_run.py --json               # machine-readable
+    python tools/scenario_run.py --plane live degraded_links churn_10pct
+
+``--plane live`` runs the campaigns over real sockets: link windows become
+chaos delay policies, churn becomes host kills, and the SAME SLO
+thresholds grade the socket-level run (scenario.live_runner).
 
 Exit code 0 iff every verdict passed (and, with ``--replay``, the stored
 flight record reproduced exactly) — the scenario suite is a regression
-gate, not a demo (PERF.md "Scenario verdicts").
+gate, not a demo (PERF.md "Scenario verdicts").  Exit 2 means a plane
+failed to START (infrastructure, not a red verdict).
 """
 
 from __future__ import annotations
@@ -63,6 +69,15 @@ def main(argv: List[str] | None = None) -> int:
                     help="write the (single) run's replayable trace here")
     ap.add_argument("--json", action="store_true",
                     help="emit verdicts as JSON instead of the table")
+    ap.add_argument("--plane", choices=("sim", "live"), default="sim",
+                    help="execution plane: device-compiled sim (default) or "
+                    "real sockets under chaos")
+    ap.add_argument("--live-hosts", type=int, default=None, metavar="N",
+                    help="live plane: number of hosts (default 16, or the "
+                    "spec's live.n_hosts)")
+    ap.add_argument("--live-step-ms", type=float, default=None, metavar="MS",
+                    help="live plane: wall-clock milliseconds per scenario "
+                    "step (default 50, or the spec's live.step_ms)")
     args = ap.parse_args(argv)
 
     if args.list:
@@ -99,11 +114,34 @@ def main(argv: List[str] | None = None) -> int:
 
     if args.save_trace and len(specs) != 1:
         ap.error("--save-trace takes exactly one scenario")
+    if args.plane == "live" and (args.save_trace or args.replay):
+        ap.error("--save-trace/--replay are sim-plane features")
+
+    if args.plane == "live" and not args.names and not args.spec:
+        # Default canon sweep: keep only what the live plane can lower
+        # (attack waves and multitopic are sim-plane subsystems).
+        skipped = [s.name for s in specs if not scenario.live_supported(s)]
+        specs = [s for s in specs if scenario.live_supported(s)]
+        if skipped:
+            print(f"# live plane: skipping unsupported canon: "
+                  f"{', '.join(skipped)}", file=sys.stderr)
 
     results = []
     for spec in specs:
         t0 = time.time()
-        res = scenario.run_scenario(spec)
+        if args.plane == "live":
+            try:
+                res = scenario.run_live_scenario(
+                    spec,
+                    n_hosts=args.live_hosts,
+                    step_s=(args.live_step_ms / 1e3
+                            if args.live_step_ms is not None else None),
+                )
+            except scenario.LivePlaneError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+        else:
+            res = scenario.run_scenario(spec)
         res.seconds = round(time.time() - t0, 3)
         results.append(res)
 
@@ -113,7 +151,9 @@ def main(argv: List[str] | None = None) -> int:
     if args.json:
         print(json.dumps(
             [dict(res.verdict.to_dict(), family=res.spec.family,
-                  n_publishes=res.compiled.n_publishes,
+                  plane=args.plane,
+                  n_publishes=(res.n_publishes if args.plane == "live"
+                               else res.compiled.n_publishes),
                   seconds=res.seconds)
              for res in results],
             indent=2,
