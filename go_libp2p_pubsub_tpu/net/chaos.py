@@ -375,3 +375,41 @@ class ChaosStream:
         if self._pump is not None and not self._pump.done():
             self._pump.cancel()
         self._heap.clear()
+
+
+# ---------------------------------------------------------------------------
+# partition helpers (scenario.live_runner's blackhole windows)
+# ---------------------------------------------------------------------------
+
+# A network partition must be *detectable*, not just silent: ``blackhole``
+# only refuses NEW dials, and a pure ``drop_prob=1.0`` link lets writes
+# "succeed" into the void, so neither side would ever notice the cut.
+# ``reset_prob=1.0`` makes the first write on an existing cross-partition
+# stream abort the connection — both sides see StreamClosed and run their
+# repair/failover machinery, which is the behavior a real L3 partition
+# (RST or timeout) produces.
+PARTITION_POLICY = LinkPolicy(blackhole=True, reset_prob=1.0)
+
+
+def install_partition(table: LinkPolicyTable, side_a, side_b,
+                      policy: LinkPolicy = PARTITION_POLICY) -> int:
+    """Cut every directed link between two host-id cohorts; returns the
+    number of rules installed (for symmetry with :func:`remove_partition`)."""
+    n = 0
+    for a in side_a:
+        for b in side_b:
+            table.set(policy, src=a, dst=b)
+            table.set(policy, src=b, dst=a)
+            n += 2
+    return n
+
+
+def remove_partition(table: LinkPolicyTable, side_a, side_b) -> int:
+    """Lift a partition installed by :func:`install_partition`; returns the
+    number of rules removed."""
+    n = 0
+    for a in side_a:
+        for b in side_b:
+            n += table.remove(src=a, dst=b)
+            n += table.remove(src=b, dst=a)
+    return n

@@ -42,6 +42,7 @@ import concurrent.futures
 import dataclasses
 import hashlib
 import json
+import os
 import threading
 from collections import deque
 from dataclasses import dataclass, field
@@ -202,6 +203,19 @@ FWD_LOG_CAP = 32
 # can outlive the memory of its original delivery.
 SEEN_DATA_CAP = 256
 
+# Failover bookkeeping bounds: how many ranked successors ride on each
+# Update (the root's direct children, admission-ordered) and how large the
+# piggybacked two-level roster (children + reported grandchildren) may
+# grow.  Both lists are advisory state pushed down the tree, not the tree
+# itself, so capping them bounds frame size without losing safety — a
+# member beyond the caps still heals through the normal join walk.
+SUCCESSOR_CAP = 8
+ROSTER_CAP = 64
+
+# How long a parked (degraded read-only) successor sleeps between re-probe
+# rounds while it waits for its partition to heal.
+PARK_RETRY_S = 0.25
+
 
 class _TreeNode:
     """Shared subtree state machine for roots and subscribers
@@ -241,6 +255,24 @@ class _TreeNode:
         self.pause: asyncio.Queue = asyncio.Queue(maxsize=4)  # repair handoff
         self.root_id: Optional[str] = None  # for rejoin-at-root
         self.closed = False
+        # -- failover state (epoch fencing + successor election) ------------
+        # ``epoch`` 0 is the whole pre-failover regime (omitted on the wire
+        # for byte parity); each successor promotion increments it and
+        # every node rejects Data/Update frames fenced below its own epoch.
+        self.epoch = 0
+        self.is_root = False        # True on LiveTopic nodes and post-promotion
+        self.degraded = False       # parked minority successor (read-only)
+        # Advisory state pushed down by the root on Update frames: the
+        # ranked successor list and the two-level membership roster the
+        # quorum check reads.
+        self.successors: List[str] = []
+        self.roster: List[str] = []
+        self._last_roster_bcast: Optional[tuple] = None
+        # Durable topic state (utils/checkpoint.save_topic_state): written on
+        # epoch/roster transitions when a path is configured.
+        self.checkpoint_path: Optional[str] = None
+        self.checkpoint_meta: Dict[str, int] = {}
+        self._ckpt_lock = asyncio.Lock()
 
     def _inc(self, name: str, value: float = 1.0) -> None:
         if self.metrics is not None:
@@ -265,6 +297,133 @@ class _TreeNode:
     def live_child_ids(self) -> List[str]:
         return [cid for cid, c in self.children.items() if not c.dead]
 
+    # -- failover: epoch fencing + successor/roster propagation --------------
+
+    def successor_list(self) -> List[str]:
+        """Rank-ordered successor list: my live direct children in admission
+        order (dict insertion order IS admission order).  Deterministic at
+        every subscriber, so all survivors converge on the same #1."""
+        return self.live_child_ids()[:SUCCESSOR_CAP]
+
+    def roster_list(self) -> List[str]:
+        """Two-level membership view: direct children plus their reported
+        children (State frames carry the full grandchild list, §2.4.4).
+        With ``tree_width=2`` the direct children alone are far too few to
+        be a meaningful electorate; two levels are what the root actually
+        knows without new protocol traffic."""
+        roster: List[str] = []
+        for cid, c in self.children.items():
+            if c.dead:
+                continue
+            if cid not in roster:
+                roster.append(cid)
+            for gid in c.child_ids:
+                if gid not in roster:
+                    roster.append(gid)
+        return roster[:ROSTER_CAP]
+
+    def adopt_epoch(self, epoch: int, why: str) -> None:
+        """Move forward to a higher epoch (higher always wins)."""
+        if epoch <= self.epoch:
+            return
+        self._inc("live.failover.epoch_adopted")
+        _log.info(
+            "epoch_adopted",
+            extra=kv(peer=self.host.id, epoch=epoch, prev=self.epoch, why=why),
+        )
+        self.epoch = epoch
+
+    def fence_frame(self, m: Message) -> bool:
+        """Epoch fence: True iff the frame may be processed.  A frame fenced
+        below my epoch is a zombie — traffic from a root (or relay chain)
+        that was deposed by a promotion — and is dropped so a returning
+        stale root cannot fork the tree.  A higher epoch is adopted: frames
+        only flow root-down, so the sender is ahead of me, not stale."""
+        if self.epoch and m.epoch < self.epoch:
+            self._inc("live.failover.stale_epoch_rejected")
+            return False
+        if m.epoch > self.epoch:
+            self.adopt_epoch(m.epoch, why="frame")
+        return True
+
+    def absorb_update(self, m: Message) -> None:
+        """Record successor/roster state piggybacked on an Update frame
+        (welcome or mid-stream roster broadcast).  Caller fences first."""
+        if m.successors:
+            self.successors = list(m.successors)
+        if m.roster:
+            self.roster = list(m.roster)
+
+    async def roster_changed(self) -> None:
+        """Root-only: membership moved — recompute the successor list and
+        roster, push them down the tree on an Update frame, and checkpoint.
+        Deduplicated against the last broadcast so State-driven calls are
+        cheap no-ops when nothing actually changed."""
+        if not self.is_root or self.closed:
+            return
+        succ, roster = self.successor_list(), self.roster_list()
+        snap = (self.epoch, tuple(succ), tuple(roster))
+        if snap == self._last_roster_bcast:
+            return
+        self._last_roster_bcast = snap
+        self.successors, self.roster = succ, roster
+        self._inc("live.failover.roster_broadcast")
+        await self.forward_message(Message(
+            type=MessageType.UPDATE,
+            epoch=self.epoch,
+            successors=succ,
+            roster=roster,
+        ))
+        await self.save_checkpoint()
+
+    async def save_checkpoint(self) -> None:
+        """Write durable topic state ``{epoch, seq, successors, roster,
+        children}`` via the atomic temp+fsync+rename path.  File I/O runs in
+        an executor so the event loop (and the socket reads behind it) never
+        blocks on disk; the lock serializes writers so a slow disk cannot
+        interleave two snapshots."""
+        if self.checkpoint_path is None or self.closed:
+            return
+        from ..utils import checkpoint as _ckpt
+
+        state = {
+            "epoch": self.epoch,
+            "successors": list(self.successors),
+            "roster": list(self.roster),
+            "children": self.live_child_ids(),
+            **self.checkpoint_meta,
+        }
+        loop = asyncio.get_event_loop()
+        async with self._ckpt_lock:
+            await loop.run_in_executor(
+                None, _ckpt.save_topic_state, self.checkpoint_path, state
+            )
+        self._inc("live.failover.checkpointed")
+
+    def load_checkpoint(self) -> bool:
+        """Restore durable topic state if a checkpoint exists; returns
+        whether one was loaded.  A restarted host re-enters at its saved
+        epoch, so it refuses welcomes from (and is fenced out of) any tree
+        regime older than the one it last saw."""
+        if self.checkpoint_path is None or not os.path.exists(self.checkpoint_path):
+            return False
+        from ..utils import checkpoint as _ckpt
+
+        state = _ckpt.load_topic_state(self.checkpoint_path)
+        self.epoch = int(state.get("epoch", 0))
+        self.successors = list(state.get("successors", []))
+        self.roster = list(state.get("roster", []))
+        for k in ("seq",):
+            if k in state:
+                self.checkpoint_meta[k] = int(state[k])
+        self._inc("live.failover.resumed")
+        _log.info(
+            "checkpoint_resumed",
+            extra=kv(peer=self.host.id, epoch=self.epoch,
+                     successors=len(self.successors)),
+        )
+        return True
+
     async def notify_parent_state(self) -> None:
         """Upward accounting (``subtree.go:137-146``), with real size and the
         full children list (§2.4.3/§2.4.4).  ``num_peers`` excludes self so
@@ -285,11 +444,17 @@ class _TreeNode:
 
     # -- admission (server side of the join walk) ----------------------------
 
-    async def handle_join(self, s: Stream, prio: bool) -> None:
+    async def handle_join(self, s: Stream, prio: bool,
+                          want_replay: bool = False) -> None:
         """Admit or redirect a joiner (``handleJoin``, ``subtree.go:106-154``).
 
         Caller must hold ``chlock`` — enforced by the two call sites
         (stream handlers and repair), unlike the reference's Part path.
+
+        ``want_replay`` is the wire ``replay`` flag carried on the Join: a
+        recovering member (post-failover rejoin, partition heal) asks for
+        the admitter's whole retained forward-log window right after the
+        welcome; content-hash dedup at the receiver absorbs the overlap.
         """
         width = self.max_width if prio else self.width
         live = self.live_child_ids()
@@ -297,7 +462,13 @@ class _TreeNode:
             await self._redirect_join(s, live)
             return
         # Admit: welcome Update names me as parent + fanout params
-        # (subtree.go:121-128).
+        # (subtree.go:121-128), plus the failover piggyback: my epoch and
+        # the successor/roster view (the root computes its own; interior
+        # nodes relay what the root last broadcast).  All three serialize
+        # only when nonzero/nonempty, so a pristine tree's welcome stays
+        # byte-identical to the reference encoder.
+        succ = self.successor_list() if self.is_root else list(self.successors)
+        roster = self.roster_list() if self.is_root else list(self.roster)
         try:
             await s.write_message(
                 Message(
@@ -305,6 +476,9 @@ class _TreeNode:
                     peers=[self.host.id],
                     tree_width=self.width,
                     tree_max_width=self.max_width,
+                    epoch=self.epoch,
+                    successors=succ,
+                    roster=roster,
                 )
             )
         except StreamClosed:
@@ -327,6 +501,16 @@ class _TreeNode:
             ),
         )
         self.host.spawn(self._handle_child_messages(s.remote_peer, child))
+        if want_replay:
+            # Recovery join: replay everything still retained.  The joiner
+            # asked because it cannot know what it missed; dedup on its side
+            # drops what it already has (at-least-once wire, exactly-once
+            # delivery — same contract as repair replay).
+            await self._replay_fwd_log(
+                s.remote_peer,
+                since=self._fwd_log[0][0] if self._fwd_log else self._fwd_idx,
+            )
+        await self.roster_changed()
         await self.notify_parent_state()
 
     async def _redirect_join(self, s: Stream, live: List[str]) -> None:
@@ -343,7 +527,12 @@ class _TreeNode:
             extra=kv(parent=self.host.id, child=s.remote_peer, to=minc),
         )
         try:
-            await s.write_message(Message(type=MessageType.UPDATE, peers=[minc]))
+            # epoch rides along (omitted at 0) so a post-failover joiner's
+            # welcome fence doesn't mistake a current-regime redirect for a
+            # zombie frame.
+            await s.write_message(Message(
+                type=MessageType.UPDATE, peers=[minc], epoch=self.epoch,
+            ))
         except StreamClosed:
             pass
         s.close()
@@ -358,6 +547,9 @@ class _TreeNode:
                 if m.type == MessageType.STATE:
                     child.size = m.num_peers + 1  # wire formula (subtree.go:59)
                     child.child_ids = list(m.peers)
+                    # Grandchild set moved: the roster may have too (dedup'd
+                    # inside roster_changed, so unchanged States are free).
+                    await self.roster_changed()
                     await self.notify_parent_state()
                 elif m.type == MessageType.PART:
                     await self._drop_child(cid, child)
@@ -395,6 +587,7 @@ class _TreeNode:
         # unknowable — replay the whole uncertainty window and let the
         # replay-flag dedup at the receivers drop what actually arrived.
         await self._redistribute(child.child_ids, since=child.admitted_fwd_idx)
+        await self.roster_changed()
         await self.notify_parent_state()
 
     async def _redistribute(self, grandchild_ids: List[str],
@@ -461,8 +654,13 @@ class _TreeNode:
         child = self.children.get(cid)
         if child is None or child.dead:
             return
+        # Re-stamp replayed frames with MY epoch: logged frames may predate
+        # a promotion (epoch 0/old), and receivers already at the new epoch
+        # would fence them out even though the content is legitimate.
         pending = [
-            dataclasses.replace(m, replay=True)
+            dataclasses.replace(
+                m, replay=True, epoch=self.epoch if self.epoch else m.epoch
+            )
             for i, m in self._fwd_log
             if since <= i < child.admitted_fwd_idx
         ]
@@ -525,10 +723,12 @@ class _TreeNode:
 
     # -- join walk (client side) ---------------------------------------------
 
-    async def join_to_peer(self, s: Stream) -> Stream:
+    async def join_to_peer(self, s: Stream, want_replay: bool = False) -> Stream:
         """Dial-side join (``joinToPeer``, ``subtree.go:196-226``): send Join,
-        adopt validated fanout params from the welcome, walk redirects."""
-        await s.write_message(Message(type=MessageType.JOIN))
+        adopt validated fanout params from the welcome, walk redirects.
+        ``want_replay`` marks the Join as a recovery (failover rejoin /
+        partition heal): the eventual admitter replays its retained window."""
+        await s.write_message(Message(type=MessageType.JOIN, replay=want_replay))
         welcome = await s.read_message()
         if welcome.tree_width and welcome.tree_max_width:
             # §2.4.10: validate instead of adopting blind (subtree.go:211-213).
@@ -536,14 +736,27 @@ class _TreeNode:
                 welcome.tree_width, welcome.tree_max_width
             )
             self.width, self.max_width = opts.tree_width, opts.tree_max_width
-        return await self._join_parents(s, welcome, hops=0)
+        return await self._join_parents(s, welcome, hops=0,
+                                        want_replay=want_replay)
 
-    async def _join_parents(self, s: Stream, welcome: Message, hops: int) -> Stream:
+    async def _join_parents(self, s: Stream, welcome: Message, hops: int,
+                            want_replay: bool = False) -> Stream:
         """Redirect walk (``joinParents``, ``subtree.go:241-307``): try each
         candidate parent; a welcome naming the sender means accepted, anything
         else is a further redirect."""
         if hops > MAX_JOIN_HOPS:
             raise StreamClosed("join walk exceeded max hops")
+        # Epoch fence on the welcome itself: a candidate parent still living
+        # in a deposed epoch is a zombie subtree — attaching under it would
+        # fork the tree.  Refuse the whole welcome (its candidate list is
+        # the same stale regime) and let the caller try the next successor.
+        if self.epoch and welcome.epoch < self.epoch:
+            self._inc("live.failover.stale_epoch_rejected")
+            s.close()
+            raise StreamClosed(
+                f"stale-epoch welcome ({welcome.epoch} < {self.epoch}) "
+                f"from {s.remote_peer}"
+            )
         last_err: Optional[Exception] = None
         candidates = welcome.peers
         if self.host.peerstore.validate_ids:
@@ -554,17 +767,25 @@ class _TreeNode:
             candidates = transl_peer_ids(candidates)
         for cand in candidates:
             if cand == s.remote_peer:
-                return s  # the sender admitted me: reuse this stream
+                # The sender admitted me: adopt its epoch and failover view,
+                # reuse this stream.
+                if welcome.epoch > self.epoch:
+                    self.adopt_epoch(welcome.epoch, why="welcome")
+                self.absorb_update(welcome)
+                return s
             try:
                 # Two attempts per candidate: the walk itself is the outer
                 # retry (next candidate), so per-hop budget stays small.
                 cs = await self.dial_retry(cand, cls="join", max_attempts=2)
-                await cs.write_message(Message(type=MessageType.JOIN))
+                await cs.write_message(
+                    Message(type=MessageType.JOIN, replay=want_replay)
+                )
                 w2 = await cs.read_message()
                 if w2.type != MessageType.UPDATE:
                     cs.close()
                     continue
-                return await self._join_parents(cs, w2, hops + 1)
+                return await self._join_parents(cs, w2, hops + 1,
+                                                want_replay=want_replay)
             except (StreamClosed, KeyError) as e:
                 last_err = e
                 continue
@@ -615,6 +836,7 @@ class LiveTopic:
         title: str,
         opts: TreeOpts,
         signer_seed: Optional[bytes] = None,
+        checkpoint_path: Optional[str] = None,
     ):
         self.tm = tm
         self.title = title
@@ -622,11 +844,22 @@ class LiveTopic:
         self.node = _TreeNode(
             tm.host, self.protoid, opts, metrics=tm.registry, retry=tm.retry
         )
+        self.node.is_root = True
         # Publisher identity: with a seed, every publish travels as a signed
         # Envelope (crypto/pipeline) inside the Data frame — the fix for the
         # reference's `// TODO: add signature` (pubsub.go:117).
         self.signer_seed = signer_seed
         self._seqno = 0
+        # Durable topic state: with a path, {epoch, seq, successors, roster}
+        # persists across restarts (atomic temp+fsync+rename), so a
+        # restarted root re-enters at the epoch it last saw instead of
+        # resurrecting a stale regime.  NOTE: re-occupying the old tree also
+        # requires a stable peer identity (the validate_ids regime, where
+        # ids derive from keys) — with throwaway ids the checkpoint still
+        # protects the epoch/seq counters.
+        self.node.checkpoint_path = checkpoint_path
+        if self.node.load_checkpoint():
+            self._seqno = self.node.checkpoint_meta.get("seq", 0)
         tm.host.set_stream_handler(self.protoid, self._stream_handler)
 
     async def _stream_handler(self, s: Stream) -> None:
@@ -639,7 +872,7 @@ class LiveTopic:
             s.close()  # "not a join message" (pubsub.go:81-85)
             return
         async with self.node.chlock:  # AddPeer's chlock (pubsub.go:106-108)
-            await self.node.handle_join(s, prio=False)
+            await self.node.handle_join(s, prio=False, want_replay=m.replay)
 
     async def publish_message(self, data: bytes) -> None:
         """``PublishMessage`` (``pubsub.go:111-120``).
@@ -656,12 +889,19 @@ class LiveTopic:
             )
             self._seqno += 1
             data = env.to_wire()
+        else:
+            self._seqno += 1  # unsigned plane: seq is the publish count
+        self.node.checkpoint_meta["seq"] = self._seqno
         self.node._inc("live.msgs_published")
         _log.debug(
             "publish",
             extra=kv(topic=self.title, root=self.tm.host.id, bytes=len(data)),
         )
-        await self.node.forward_message(Message(type=MessageType.DATA, data=data))
+        # Data carries the current epoch (omitted at 0): post-failover
+        # receivers fence out anything a deposed root keeps publishing.
+        await self.node.forward_message(Message(
+            type=MessageType.DATA, data=data, epoch=self.node.epoch,
+        ))
 
     async def close(self) -> None:
         """Reference-parity close (``pubsub.go:99-103``): unregister only;
@@ -686,6 +926,7 @@ class LiveSubscription:
         repair_timeout_s: float,
         out_buffer: int = DELIVERY_BUFFER,
         validate: Optional[str] = None,
+        checkpoint_path: Optional[str] = None,
     ):
         self.tm = tm
         self.protoid = f"{root_id}/{title}"
@@ -698,6 +939,11 @@ class LiveSubscription:
             retry=tm.retry,
         )
         self.node.root_id = root_id
+        # Successors checkpoint too (they may be promoted): a restarted
+        # successor re-enters at its saved epoch, so stale-regime welcomes
+        # are refused from the very first join walk.
+        self.node.checkpoint_path = checkpoint_path
+        self.node.load_checkpoint()
         # client.out, cap 16 (client.go:79): a full queue blocks the receive
         # loop — backpressure by design.
         self.out: asyncio.Queue = asyncio.Queue(maxsize=out_buffer)
@@ -737,8 +983,27 @@ class LiveSubscription:
             return
         if m.type == MessageType.JOIN:
             async with self.node.chlock:
-                await self.node.handle_join(s, prio=False)
+                await self.node.handle_join(s, prio=False, want_replay=m.replay)
         elif m.type == MessageType.UPDATE:
+            ps = self.node.parent_stream
+            if self.node.is_root or (ps is not None and not ps.closed):
+                # Adoption aimed at a node that is not actually orphaned —
+                # a partition hid the live parent from the repairer, or we
+                # already promoted.  REFUSE with Part instead of queueing:
+                # a parked adoption would leave the adopter a phantom child
+                # it believes it repaired, and worse, let a recovering
+                # ancestor later be redirect-walked into its own (dark)
+                # subtree — a delivery cycle that starves the whole
+                # component.  Refused, the cut-off component stays one
+                # coherent subtree under its parked head and re-merges as
+                # a unit when the partition lifts.
+                self.node._inc("live.adoption_refused")
+                try:
+                    await s.write_message(Message(type=MessageType.PART))
+                except StreamClosed:
+                    pass
+                s.close()
+                return
             try:
                 ns = await self.node._join_parents(s, m, hops=0)
             except StreamClosed:
@@ -747,12 +1012,29 @@ class LiveSubscription:
         else:
             s.close()
 
+    def _remember(self, h: bytes) -> bool:
+        """Record a payload digest in the dedup window; False if the digest
+        was already present (the frame is a duplicate)."""
+        if h in self._seen_data:
+            return False
+        self._seen_data.add(h)
+        self._seen_order.append(h)
+        if len(self._seen_order) > SEEN_DATA_CAP:
+            self._seen_data.discard(self._seen_order.popleft())
+        return True
+
     async def _process_messages(self) -> None:
         """Receive/relay loop (``processMessages``, ``client.go:100-132``):
-        deliver before forwarding; on parent death pause for repair, and past
-        the deadline rejoin at the root (the reference panics here, §2.4.8)."""
+        deliver before forwarding; on parent death pause for repair, past
+        the deadline rejoin at the root (the reference panics here, §2.4.8),
+        and — this build's failover extension — past THAT walk the successor
+        list: converge on the highest-ranked reachable successor, promote if
+        I am next in line and can reach a quorum of the roster, park
+        degraded otherwise."""
         node = self.node
         while not node.closed:
+            if node.parent_stream is None:
+                return  # promoted to root: the server-side handlers take over
             try:
                 m = await node.parent_stream.read_message()
             except StreamClosed:
@@ -767,43 +1049,62 @@ class LiveSubscription:
                     )
                 except asyncio.TimeoutError:
                     if not await self._rejoin_root():
-                        # Unreachable root: this subscription is over, but an
-                        # adoption may still race in — Part any queued streams
-                        # so no repairer retains us as an unread child.
-                        await node.drain_stale_adoptions()
-                        return
+                        if not await self._failover():
+                            # Root unreachable and nothing to fail over to:
+                            # this subscription is over, but an adoption may
+                            # still race in — Part any queued streams so no
+                            # repairer retains us as an unread child.
+                            node.closed = True
+                            await node.drain_stale_adoptions()
+                            return
                 # A second repairer (or an adoption racing the rejoin) may
                 # have queued another stream: keep the parent we have, Part
                 # the losers so no node retains us as an unread child.
                 await node.drain_stale_adoptions()
+                if node.is_root:
+                    return  # promoted: no parent to read from
                 await node.notify_parent_state()
                 continue
             if m.type == MessageType.DATA:
+                # Epoch fence before anything else: zombie-regime traffic is
+                # neither delivered, relayed, nor validated.
+                if not node.fence_frame(m):
+                    continue
                 if self.validator is not None:
                     # Verdict-gated path: the batch validator delivers and
-                    # relays (in arrival order) only what verifies.
+                    # relays (in arrival order) only what verifies (its
+                    # monotonic-seqno guard is the dedup on this plane).
                     await self.validator.submit(m)
                     continue
-                h = hashlib.sha256(m.data).digest()
-                if m.replay and h in self._seen_data:
-                    continue  # repair replay of an already-delivered frame
-                self._seen_data.add(h)
-                self._seen_order.append(h)
-                if len(self._seen_order) > SEEN_DATA_CAP:
-                    self._seen_data.discard(self._seen_order.popleft())
+                # Content-hash dedup on EVERY Data frame (not just flagged
+                # replays): a chaos-duplicated frame, a replay overlap, or a
+                # post-heal re-merge all collapse to one delivery.
+                if not self._remember(hashlib.sha256(m.data).digest()):
+                    node._inc("live.dup_suppressed")
+                    continue
                 await self.out.put(m.data)        # deliver (client.go:124-127)
                 await node.forward_message(m)     # then relay (client.go:130)
             elif m.type == MessageType.UPDATE:
-                # Unexpected mid-stream Update: ignore (reference logs).
-                continue
+                # Mid-stream Update: the failover piggyback channel — the
+                # root's successor/roster broadcast riding down the tree.
+                # (The reference ignores mid-stream Updates.)
+                if not node.fence_frame(m):
+                    continue
+                node.absorb_update(m)
+                await node.forward_message(m)     # propagate to my subtree
+                if node.checkpoint_path is not None:
+                    await node.save_checkpoint()
 
-    async def _rejoin_root(self) -> bool:
+    async def _rejoin_root(self, recover: bool = True) -> bool:
         """``rejoinRoot`` — implemented (vs ``panic``, ``client.go:96-98``).
 
         The whole dial+walk runs under the retry policy with the repair
         timeout as its deadline: a transiently unreachable root costs
         backoff, not the subscription (the reference-shaped single attempt
-        gave up on the first refused dial)."""
+        gave up on the first refused dial).  ``recover`` marks the Join
+        with the replay flag so the admitter closes the loss window from
+        its forward log.  Failure no longer ends the subscription — the
+        caller escalates to the successor failover."""
         self.node._inc("live.rejoin_root")
         _log.info(
             "rejoin_root",
@@ -812,7 +1113,7 @@ class LiveSubscription:
 
         async def _attempt() -> Stream:
             s = await self.tm.host.new_stream(self.node.root_id, self.protoid)
-            return await self.node.join_to_peer(s)
+            return await self.node.join_to_peer(s, want_replay=recover)
 
         try:
             self.node.parent_stream = await self.node.retry.run(
@@ -820,8 +1121,212 @@ class LiveSubscription:
             )
             return True
         except (StreamClosed, KeyError, OSError, asyncio.TimeoutError):
-            self.node.closed = True
             return False
+
+    # -- root failover (the §2.4.8 rejoin's missing other half) --------------
+
+    async def _failover(self) -> bool:
+        """The root is gone past the rejoin deadline.  Walk the successor
+        list the root pushed down before dying: join the highest-ranked
+        reachable successor; if every higher rank is unreachable and I am
+        next in line, quorum-probe the roster and promote myself; if the
+        quorum is unreachable (minority side of a partition), park in
+        degraded read-only and keep probing until the partition heals or
+        the subscription closes.  Returns False only when there is no
+        successor knowledge at all (the pre-failover contract: subscription
+        over)."""
+        node = self.node
+        me = self.tm.host.id
+        if not node.successors:
+            return False
+        node._inc("live.failover.engaged")
+        while not node.closed:
+            epoch_at_walk = node.epoch
+            succs = list(node.successors)
+            rank = succs.index(me) if me in succs else None
+            ahead = succs if rank is None else succs[:rank]
+            for cand in ahead:
+                if cand == me:
+                    continue
+                try:
+                    s = await node.dial_retry(
+                        cand, cls="failover", max_attempts=2
+                    )
+                    node.parent_stream = await node.join_to_peer(
+                        s, want_replay=True
+                    )
+                except (StreamClosed, KeyError, OSError, asyncio.TimeoutError):
+                    continue
+                if node.degraded:
+                    node.degraded = False
+                    node._inc("live.failover.unparked")
+                node._inc("live.failover.rejoined_successor")
+                _log.info(
+                    "failover_rejoined",
+                    extra=kv(peer=me, parent=cand, epoch=node.epoch),
+                )
+                return True
+            # The walk failed — but did the world move while we walked?  A
+            # promotion elsewhere surfaces here as (a) an adoption handoff
+            # already queued in pause, or (b) an epoch bump absorbed from a
+            # welcome mid-walk (the walk itself then died on stale-epoch
+            # welcomes from peers the new roster broadcast hadn't reached
+            # yet).  Either way a live regime claimed us: promoting now
+            # would mint a second root inside a healthy component.  Take
+            # the invitation, or re-walk under the new successor list.
+            try:
+                ns = node.pause.get_nowait()
+            except asyncio.QueueEmpty:
+                pass
+            else:
+                node.parent_stream = ns
+                if node.degraded:
+                    node.degraded = False
+                node._inc("live.failover.adopted")
+                _log.info(
+                    "failover_adopted", extra=kv(peer=me, epoch=node.epoch)
+                )
+                return True
+            if node.epoch != epoch_at_walk:
+                continue
+            if rank is not None:
+                # I am the highest-ranked successor still standing: promote
+                # only with a reachable quorum — split-brain rule: the
+                # minority side must never mint an epoch.
+                if await self._quorum_reachable():
+                    await self._promote()
+                    return True
+                node._inc("live.failover.quorum_lost")
+            # Park: degraded read-only.  Wake on an adoption handoff, else
+            # re-probe the root and re-walk the successors next round.
+            if not node.degraded:
+                node.degraded = True
+                node._inc("live.failover.parked")
+                _log.info(
+                    "failover_parked",
+                    extra=kv(peer=me, epoch=node.epoch, rank=rank),
+                )
+            try:
+                ns = await asyncio.wait_for(node.pause.get(), PARK_RETRY_S)
+            except asyncio.TimeoutError:
+                pass
+            else:
+                node.parent_stream = ns
+                node.degraded = False
+                node._inc("live.failover.unparked")
+                return True
+            if await self._probe_root_once():
+                return True
+        return False
+
+    async def _probe_root_once(self) -> bool:
+        """One cheap rejoin attempt at the original root (park loop): the
+        common heal path — the partition lifts and the root is right there."""
+        node = self.node
+
+        async def _attempt() -> Stream:
+            s = await self.tm.host.new_stream(node.root_id, self.protoid)
+            return await node.join_to_peer(s, want_replay=True)
+
+        ns = await node.retry.probe(
+            _attempt, timeout_s=max(2 * PARK_RETRY_S, 0.5), cls="park"
+        )
+        if ns is None:
+            return False
+        node.parent_stream = ns
+        if node.degraded:
+            node.degraded = False
+            node._inc("live.failover.unparked")
+        _log.info(
+            "failover_healed",
+            extra=kv(peer=self.tm.host.id, root=node.root_id, epoch=node.epoch),
+        )
+        return True
+
+    async def _quorum_reachable(self) -> bool:
+        """Probe the roster (minus me and the dead root): promotion needs a
+        strict majority of the electorate (roster ∪ me) reachable right now.
+        Single-attempt short-timeout probes — a quorum check measures the
+        present, it does not retry its way into the past."""
+        node = self.node
+        me = self.tm.host.id
+        electorate = [
+            r for r in node.roster if r not in (me, node.root_id)
+        ]
+        total = len(electorate) + 1           # the electorate includes me
+        need = total // 2 + 1                 # strict majority
+        if not electorate:
+            # No roster beyond myself: a 1-member electorate, quorum of one.
+            return True
+
+        async def _probe_one(rid: str) -> bool:
+            async def _dial() -> Stream:
+                return await self.tm.host.new_stream(rid, self.protoid)
+
+            s = await node.retry.probe(_dial, timeout_s=0.25, cls="probe")
+            if s is None:
+                return False
+            s.close()  # reachability only; the receiver sees EOF and moves on
+            return True
+
+        results = await asyncio.gather(*(_probe_one(r) for r in electorate))
+        reachable = 1 + sum(results)
+        ok = reachable >= need
+        node._inc("live.failover.quorum_probe")
+        _log.info(
+            "quorum_probe",
+            extra=kv(peer=me, reachable=reachable, total=total, ok=ok),
+        )
+        return ok
+
+    async def _promote(self) -> None:
+        """Successor #1 with a quorum: become the root.  Bump the epoch
+        (fencing out the dead/zombie regime), re-adopt the dead root's
+        other direct children with the existing repair machinery, replay
+        the forward-log uncertainty window, and broadcast the new regime."""
+        node = self.node
+        me = self.tm.host.id
+        node.epoch += 1
+        node.is_root = True
+        node.degraded = False
+        node.parent_stream = None
+        node._inc("live.failover.promoted")
+        orphans = [x for x in node.successors if x != me]
+        _log.info(
+            "promoted",
+            extra=kv(peer=me, epoch=node.epoch, orphans=len(orphans)),
+        )
+        # The dead root's OTHER direct children are the orphaned subtree
+        # heads; deeper roster members still hang off live parents and must
+        # not be re-dialed (double-parenting).  Replay horizon: the whole
+        # retained window — what of it the dead root delivered is unknowable,
+        # and receiver-side dedup absorbs the overlap.
+        since = node._fwd_log[0][0] if node._fwd_log else node._fwd_idx
+        await node._redistribute(orphans, since=since)
+        node._last_roster_bcast = None  # force the first new-epoch broadcast
+        await node.roster_changed()
+
+    async def publish_message(self, data: bytes) -> None:
+        """Publish as a PROMOTED root (epoch >= 1).  The original publisher
+        is gone; the tree's data plane continues from the successor.  Only
+        the unsigned plane can be resumed this way — signing would need the
+        dead root's key, which is exactly what a successor must not have."""
+        node = self.node
+        if not node.is_root:
+            raise RuntimeError(
+                "publish_message requires a promoted (root) subscription"
+            )
+        if self.validator is not None:
+            raise RuntimeError(
+                "cannot publish on the signed plane from a promoted "
+                "successor (the root's signing key died with it)"
+            )
+        self._remember(hashlib.sha256(data).digest())
+        node._inc("live.msgs_published")
+        await self.out.put(data)  # self-delivery: I am still a subscriber
+        await node.forward_message(Message(
+            type=MessageType.DATA, data=data, epoch=node.epoch,
+        ))
 
     async def close(self) -> None:
         """Graceful leave (``client.Close``, ``client.go:30-34``)."""
@@ -863,16 +1368,20 @@ class LiveTopicManager:
         title: str,
         opts: Optional[TreeOpts] = None,
         signer_seed: Optional[bytes] = None,
+        checkpoint_path: Optional[str] = None,
     ) -> LiveTopic:
-        t = LiveTopic(self, title, opts or TreeOpts(), signer_seed=signer_seed)
+        t = LiveTopic(self, title, opts or TreeOpts(), signer_seed=signer_seed,
+                      checkpoint_path=checkpoint_path)
         self.topics[title] = t
         return t
 
     async def subscribe(
-        self, root_id: str, title: str, validate: Optional[str] = None
+        self, root_id: str, title: str, validate: Optional[str] = None,
+        checkpoint_path: Optional[str] = None,
     ) -> LiveSubscription:
         sub = LiveSubscription(
-            self, root_id, title, self.repair_timeout_s, validate=validate
+            self, root_id, title, self.repair_timeout_s, validate=validate,
+            checkpoint_path=checkpoint_path,
         )
         await sub.start()
         self.subscriptions.append(sub)
@@ -1021,6 +1530,15 @@ class LiveNetwork:
         self._sync_hosts: List["SyncHost"] = []
         self._metrics_server: Optional[MetricsHTTPServer] = None
         self._loop = asyncio.new_event_loop()
+        # LIVE_DEBUG=1: asyncio debug mode on the plane's loop — unawaited
+        # coroutine warnings, slow-callback reports (anything over 100 ms
+        # holding the loop, i.e. anything that would stall every socket on
+        # the host), and full task creation tracebacks.  Costs real overhead,
+        # so it is opt-in via environment, never default.
+        if os.environ.get("LIVE_DEBUG") == "1":
+            self._loop.set_debug(True)
+            self._loop.slow_callback_duration = 0.1
+            _log.info("live_debug_enabled", extra=kv(slow_callback_s=0.1))
         self._thread = threading.Thread(target=self._loop.run_forever, daemon=True)
         self._thread.start()
         self._counter = 0
@@ -1105,17 +1623,24 @@ class SyncHost:
         title: str,
         opts: Optional[TreeOpts] = None,
         signer_seed: Optional[bytes] = None,
+        checkpoint_path: Optional[str] = None,
     ) -> "SyncTopic":
         return SyncTopic(
             self.net,
-            self.net.call(self.tm.new_topic(title, opts, signer_seed=signer_seed)),
+            self.net.call(self.tm.new_topic(
+                title, opts, signer_seed=signer_seed,
+                checkpoint_path=checkpoint_path,
+            )),
         )
 
     def subscribe(
-        self, root_id: str, title: str, validate: Optional[str] = None
+        self, root_id: str, title: str, validate: Optional[str] = None,
+        checkpoint_path: Optional[str] = None,
     ) -> "SyncSubscription":
         return SyncSubscription(
-            self.net, self.net.call(self.tm.subscribe(root_id, title, validate))
+            self.net, self.net.call(self.tm.subscribe(
+                root_id, title, validate, checkpoint_path=checkpoint_path,
+            ))
         )
 
     def close(self, graceful: bool = False) -> None:
@@ -1159,6 +1684,13 @@ class SyncSubscription:
                 return None
 
         return self.net.call(_try())
+
+    def publish_message(self, data: bytes) -> None:
+        """Publish from a PROMOTED subscription (post-failover root)."""
+        self.net.call(self.sub.publish_message(data))
+
+    def is_promoted(self) -> bool:
+        return self.sub.node.is_root
 
     def clear(self) -> None:
         """Drain pending deliveries (``clearWaitingMessages``,

@@ -225,6 +225,28 @@ class RetryPolicy:
         assert last is not None
         raise last
 
+    async def probe(
+        self,
+        fn: Callable[[], Awaitable],
+        timeout_s: float = 0.25,
+        cls: str = "probe",
+    ):
+        """Single-attempt, short-deadline liveness probe: no retries, no
+        backoff, no breaker involvement.  A quorum check (live.py failover)
+        must measure reachability *now* — burning decorrelated-jitter budget
+        on each roster member would stretch time-to-heal by the whole
+        electorate.  Returns ``fn()``'s result, or ``None`` on any retryable
+        failure/timeout (accounted as ``live.retry.<cls>.{attempt,success,
+        exhausted}``)."""
+        self._inc(f"live.retry.{cls}.attempt")
+        try:
+            result = await asyncio.wait_for(fn(), timeout=timeout_s)
+        except RETRYABLE:
+            self._inc(f"live.retry.{cls}.exhausted")
+            return None
+        self._inc(f"live.retry.{cls}.success")
+        return result
+
     async def wait_for(self, aw: Awaitable, timeout_s: float, cls: str):
         """``asyncio.wait_for`` with the timeout accounted to ``cls`` in
         the registry — the typed replacement for the live plane's bare

@@ -15,6 +15,7 @@ from .live_runner import (
     LiveScenarioResult,
     live_supported,
     run_live_scenario,
+    sim_supported,
 )
 from .runner import (
     ScenarioResult,
@@ -58,5 +59,6 @@ __all__ = [
     "run_scenario",
     "run_suite",
     "save_trace",
+    "sim_supported",
     "trace_document",
 ]

@@ -214,6 +214,66 @@ def multitopic_hot_publisher() -> ScenarioSpec:
     )
 
 
+def root_kill_failover() -> ScenarioSpec:
+    """LIVE-ONLY: the root (sole publisher, the protocol's single point of
+    failure) is abruptly killed mid-run.  The survivors must converge on
+    successor #1, which promotes itself under a new epoch, re-adopts the
+    orphaned subtrees, and replays the uncertainty window; buffered
+    publishes resume through the promoted root.  Graded on exact delivery
+    (1.00 including replay), epoch agreement (everyone on the SAME new
+    epoch — no fork), and zero duplicate deliveries."""
+    return ScenarioSpec(
+        name="root_kill_failover",
+        family="gossipsub",
+        n_steps=48,
+        seed=43,
+        workloads=[Workload(kind="constant", start=2, stop=44, every=2)],
+        live={
+            "n_hosts": 16,
+            "kill_root_at": 12,
+            "settle_s": 2.0,
+            "live_only": True,
+        },
+        slo=SLO(
+            min_delivery_frac=1.0,
+            min_final_epoch=1,
+            max_epoch_spread=0,
+            max_duplicate_deliveries=0,
+        ),
+        description="Root killed at step 12; successor promotes, epoch "
+                    "fences the old regime, survivors lose nothing.",
+    )
+
+
+def live_partition_heal() -> ScenarioSpec:
+    """LIVE-ONLY: a minority cohort is blackholed away from the rest of the
+    tree (dials fail, existing cross-cut streams reset on first write) and
+    re-merges when the window lifts.  The minority must NOT mint an epoch
+    (quorum gate: parked degraded read-only), and on heal the forward-log
+    replay plus content-hash dedup must close the loss window without a
+    single duplicate delivery."""
+    return ScenarioSpec(
+        name="live_partition_heal",
+        family="gossipsub",
+        n_steps=64,
+        seed=47,
+        workloads=[Workload(kind="constant", start=2, stop=56, every=2)],
+        live={
+            "n_hosts": 16,
+            "settle_s": 2.0,
+            "live_only": True,
+            "partition": {"start": 12, "stop": 40, "peers": [1, 6, 9, 13]},
+        },
+        slo=SLO(
+            min_delivery_frac=0.98,
+            max_epoch_spread=0,
+            max_duplicate_deliveries=0,
+        ),
+        description="4 peers blackholed for 28 steps; minority parks "
+                    "(no split-brain epoch), heals by replay + dedup.",
+    )
+
+
 CANON: Dict[str, Callable[[], ScenarioSpec]] = {
     "steady_state": steady_state,
     "flash_crowd": flash_crowd,
@@ -225,6 +285,8 @@ CANON: Dict[str, Callable[[], ScenarioSpec]] = {
     "degraded_links": degraded_links,
     "tree_churn_heal": tree_churn_heal,
     "multitopic_hot_publisher": multitopic_hot_publisher,
+    "root_kill_failover": root_kill_failover,
+    "live_partition_heal": live_partition_heal,
 }
 
 

@@ -45,7 +45,9 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..net.chaos import ChaosTransport, LinkPolicy
+from ..net.chaos import (
+    ChaosTransport, LinkPolicy, install_partition, remove_partition,
+)
 from ..net.live import LiveNetwork, SyncHost, SyncSubscription
 from . import slo as slo_mod
 from .compiler import _TAG_CHURN, _TAG_LINK, _rng, _window
@@ -71,6 +73,9 @@ class LiveScenarioResult:
     chaos_trace: Dict[tuple, list]
     counters: Dict[str, float]
     seconds: float = 0.0
+    # Failover time-to-heal: wall seconds from the root kill to the first
+    # survivor observed promoted (None when the scenario kills no root).
+    heal_s: Optional[float] = None
 
 
 def live_supported(spec: ScenarioSpec) -> bool:
@@ -80,6 +85,13 @@ def live_supported(spec: ScenarioSpec) -> bool:
         and not spec.attacks
         and all(w.valid for w in spec.workloads)
     )
+
+
+def sim_supported(spec: ScenarioSpec) -> bool:
+    """Can this spec be lowered onto the sim plane?  Live-only scenarios
+    (root failover, socket-level partition heal) have no device lowering —
+    the mirror image of :func:`live_supported`."""
+    return not spec.live_only
 
 
 def _reject_unsupported(spec: ScenarioSpec) -> None:
@@ -115,12 +127,16 @@ class _Member:
     end_step: Optional[int] = None  # step it left/was killed (None = survivor)
     killed: bool = False
     receipts: Dict[int, float] = dataclasses.field(default_factory=dict)
+    dups: int = 0  # same message index DELIVERED twice (dedup failure)
     stop: threading.Event = dataclasses.field(default_factory=threading.Event)
     thread: Optional[threading.Thread] = None
 
 
 def _collect(member: _Member) -> None:
-    """Collector thread: drain one member's deliveries with receipt times."""
+    """Collector thread: drain one member's deliveries with receipt times.
+    A message index surfacing twice is a duplicate DELIVERY — the live
+    plane's content-hash dedup failed — and is counted, not overwritten:
+    the ``max_duplicate_deliveries`` SLO reads the sum."""
     while not member.stop.is_set():
         try:
             payload = member.sub.get(timeout=0.2)
@@ -132,7 +148,10 @@ def _collect(member: _Member) -> None:
             idx = int(payload.split(b":")[1])
         except (IndexError, ValueError):
             continue
-        member.receipts.setdefault(idx, time.monotonic())
+        if idx in member.receipts:
+            member.dups += 1
+        else:
+            member.receipts[idx] = time.monotonic()
 
 
 def run_live_scenario(
@@ -148,6 +167,8 @@ def run_live_scenario(
     dt = float(
         step_s if step_s is not None else live_cfg.get("step_ms", 50.0) / 1e3
     )
+    if settle_s is None and "settle_s" in live_cfg:
+        settle_s = float(live_cfg["settle_s"])
     if n < 2:
         raise ValueError("live scenario needs n_hosts >= 2 (root + 1)")
     T = spec.n_steps
@@ -274,6 +295,30 @@ def _drive(spec, net, chaos, hosts, topic, members, n, T, dt,
         m.thread.start()
         members[p].append(m)
 
+    # -- failover lowering (live-only adversities, spec.live) ---------------
+    live_cfg = spec.live or {}
+    kill_root_at = live_cfg.get("kill_root_at")  # step: abrupt root kill
+    part_cfg = live_cfg.get("partition")  # {"start","stop","peers"}: blackhole
+    root_dead = False
+    t_kill: Optional[float] = None
+    heal_s: Optional[float] = None
+    promoted: Optional[_Member] = None
+    pending_pubs: List[int] = []  # published while no root exists yet
+    partition_sides: Optional[Tuple[List[str], List[str]]] = None
+
+    def find_promoted() -> Optional[_Member]:
+        for gens in members.values():
+            for m in gens:
+                if m.end_step is None and m.sub.sub.node.is_root:
+                    return m
+        return None
+
+    def flush_pending(via: _Member) -> None:
+        for idx in pending_pubs:
+            via.sub.publish_message(pub_payloads[idx])
+            pub_wall[idx] = time.monotonic()
+        pending_pubs.clear()
+
     # -- the paced campaign loop -------------------------------------------
     t0 = time.monotonic()
     pub_payloads = [f"scn:{i}".encode() for i in range(len(requests))]
@@ -288,6 +333,37 @@ def _drive(spec, net, chaos, hosts, topic, members, n, T, dt,
             if now >= target_t:
                 break
             time.sleep(min(dt, target_t - now))
+        if part_cfg is not None and t == int(part_cfg["start"]):
+            # Blackhole + reset the minority cohort away from everyone else:
+            # dials across the cut fail, the first write on any existing
+            # cross-cut stream aborts it (both ends must DETECT the cut;
+            # drop-only faults are silent).  Host ids are resolved at
+            # install time so rejoined generations partition correctly.
+            minority = set(int(p) for p in part_cfg["peers"])
+            side_a = [
+                m.host.id for p in sorted(minority)
+                if (m := current(p)) is not None
+            ]
+            side_b = [hosts[0].id] + [
+                m.host.id for p in range(1, n)
+                if p not in minority and (m := current(p)) is not None
+            ]
+            partition_sides = (side_a, side_b)
+            install_partition(chaos.table, side_a, side_b)
+        if part_cfg is not None and t == int(part_cfg["stop"]) \
+                and partition_sides is not None:
+            remove_partition(chaos.table, *partition_sides)
+            partition_sides = None
+        if kill_root_at is not None and t == int(kill_root_at) \
+                and not root_dead:
+            hosts[0].close()  # abrupt: streams abort, no Part, no handover
+            root_dead = True
+            t_kill = time.monotonic()
+        if root_dead and promoted is None:
+            promoted = find_promoted()
+            if promoted is not None:
+                heal_s = time.monotonic() - t_kill
+                flush_pending(promoted)
         for p, delay_s in link_installs[t]:
             m = current(p)
             if m is not None:
@@ -344,10 +420,19 @@ def _drive(spec, net, chaos, hosts, topic, members, n, T, dt,
                     depart(p, t, graceful=True)
                 subscribed[ids] = False
         for idx in pub_steps[t]:
-            topic.publish_message(pub_payloads[idx])
-            pub_wall[idx] = time.monotonic()
+            if not root_dead:
+                topic.publish_message(pub_payloads[idx])
+                pub_wall[idx] = time.monotonic()
+            elif promoted is not None:
+                promoted.sub.publish_message(pub_payloads[idx])
+                pub_wall[idx] = time.monotonic()
+            else:
+                # The root is dead and no successor has promoted yet: the
+                # workload buffers, exactly as a real publisher fronting
+                # this tree would have to, and flushes on promotion.
+                pending_pubs.append(idx)
         # per-step observability (the treecast channels the SLO reads).
-        peers_alive[t] = 1 + sum(
+        peers_alive[t] = (0 if root_dead else 1) + sum(
             1 for p in range(1, n)
             if alive[p] and subscribed[p] and current(p) is not None
         )
@@ -359,7 +444,18 @@ def _drive(spec, net, chaos, hosts, topic, members, n, T, dt,
         else max(0.75, 10 * dt + max(
             [w.delay * dt for w in spec.links], default=0.0))
     )
-    time.sleep(settle)
+    settle_deadline = time.monotonic() + settle
+    if root_dead and promoted is None:
+        # Promotion may land after the last step: poll for it through the
+        # settle window so buffered publishes still flush and get graded.
+        while time.monotonic() < settle_deadline:
+            promoted = find_promoted()
+            if promoted is not None:
+                heal_s = time.monotonic() - t_kill
+                flush_pending(promoted)
+                break
+            time.sleep(dt)
+    time.sleep(max(0.0, settle_deadline - time.monotonic()))
     if T:
         peers_orphaned[T - 1] = _count_orphans(members, current, n)
 
@@ -369,6 +465,20 @@ def _drive(spec, net, chaos, hosts, topic, members, n, T, dt,
         spec, members, requests, pub_wall, t0, dt, T,
         peers_alive, peers_orphaned,
     )
+    # Failover channels (family-agnostic; constant series read at [-1] by
+    # slo.evaluate): the surviving members' epoch agreement and the total
+    # duplicate deliveries across every generation.
+    epochs = [
+        m.sub.sub.node.epoch
+        for p in range(1, n) if (m := current(p)) is not None
+    ]
+    record["final_epoch"] = np.full(
+        max(T, 1), min(epochs) if epochs else 0, np.int64)
+    record["epoch_spread"] = np.full(
+        max(T, 1), (max(epochs) - min(epochs)) if epochs else 0, np.int64)
+    record["duplicate_deliveries"] = np.full(
+        max(T, 1),
+        sum(m.dups for gens in members.values() for m in gens), np.int64)
     verdict = slo_mod.evaluate(spec, record, n_pub)
     return LiveScenarioResult(
         spec=spec,
@@ -378,6 +488,7 @@ def _drive(spec, net, chaos, hosts, topic, members, n, T, dt,
         chaos_trace=chaos.trace(),
         counters=net.registry.counters(),
         seconds=round(time.monotonic() - t_begin, 3),
+        heal_s=round(heal_s, 3) if heal_s is not None else None,
     )
 
 
@@ -388,6 +499,8 @@ def _count_orphans(members, current, n: int) -> int:
         if m is None:
             continue
         node = m.sub.sub.node
+        if node.is_root:
+            continue  # a promoted successor HAS no parent by design
         ps = node.parent_stream
         if not node.closed and (ps is None or ps.closed):
             c += 1

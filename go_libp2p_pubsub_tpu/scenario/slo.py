@@ -150,6 +150,35 @@ def evaluate(
                 record["target_honest_mesh_edges"][-1],
             ))
 
+    # Failover criteria (family-agnostic: the live runner emits these
+    # channels for whatever family it ran).  Requesting one without the
+    # channel is a misconfigured scenario, not a vacuous pass.
+    def _failover_channel(key: str, slo_name: str) -> np.ndarray:
+        if not have(key):
+            raise ValueError(
+                f"{slo_name} SLO needs the {key!r} record channel "
+                "(emitted by the live runner's failover scenarios)"
+            )
+        return record[key]
+
+    if slo.min_final_epoch is not None:
+        crits.append(_crit(
+            "final_epoch", "min", slo.min_final_epoch,
+            _failover_channel("final_epoch", "min_final_epoch")[-1],
+        ))
+    if slo.max_epoch_spread is not None:
+        crits.append(_crit(
+            "epoch_spread", "max", slo.max_epoch_spread,
+            _failover_channel("epoch_spread", "max_epoch_spread")[-1],
+        ))
+    if slo.max_duplicate_deliveries is not None:
+        crits.append(_crit(
+            "duplicate_deliveries", "max", slo.max_duplicate_deliveries,
+            _failover_channel(
+                "duplicate_deliveries", "max_duplicate_deliveries"
+            )[-1],
+        ))
+
     return Verdict(
         scenario=spec.name,
         passed=all(c.passed for c in crits),

@@ -173,6 +173,15 @@ class SLO:
     min_final_target_honest_edges: Optional[int] = None
     min_delivered_total: Optional[int] = None        # tree
     max_final_orphans: Optional[int] = None          # tree
+    # Failover criteria (live plane, scenario.live_runner): graded from the
+    # ``final_epoch`` / ``epoch_spread`` / ``duplicate_deliveries`` record
+    # channels.  ``min_final_epoch`` asserts a promotion actually happened
+    # (every survivor at epoch >= N); ``max_epoch_spread`` asserts the
+    # survivors CONVERGED (spread 0 = no forked regime); the duplicates cap
+    # is the exactly-once delivery bound across replay/heal overlap.
+    min_final_epoch: Optional[int] = None
+    max_epoch_spread: Optional[int] = None
+    max_duplicate_deliveries: Optional[int] = None
 
 
 @dataclass
@@ -205,6 +214,14 @@ class ScenarioSpec:
             raise ValueError(f"unknown family {self.family!r}")
         if self.n_steps < 1:
             raise ValueError("n_steps must be >= 1")
+
+    @property
+    def live_only(self) -> bool:
+        """True when the scenario exercises behavior that exists only on
+        the socket plane (root failover, partition heal) and therefore has
+        no sim lowering.  Marked via ``live={"live_only": True, ...}`` so
+        the JSON round-trip stays exact."""
+        return bool((self.live or {}).get("live_only"))
 
     # -- FaultPlan bridge ---------------------------------------------------
 

@@ -27,6 +27,29 @@ import numpy as np
 
 _META_KEY = "__pubsub_ckpt_meta__"
 _FORMAT_VERSION = 1
+_TOPIC_STATE_VERSION = 1
+
+
+def _atomic_write(path: str, write_fn) -> None:
+    """Write a file atomically: temp file in the target directory, fsync,
+    then ``os.replace``.  A crash at any point leaves either the previous
+    file intact or the new one complete — never a torn write.  The fsync
+    before the rename is what upgrades "atomic against concurrent readers"
+    to "atomic against power loss": without it the rename can be durable
+    while the data is not."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            write_fn(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
 
 
 def _leaf_paths(tree: Any):
@@ -51,20 +74,10 @@ def save(path: str, state: Any, meta: Optional[Dict[str, Any]] = None) -> None:
     arrays[_META_KEY] = np.frombuffer(
         json.dumps(header).encode("utf-8"), dtype=np.uint8
     )
-    # Write-then-rename so a crash mid-save never corrupts the previous
-    # checkpoint — the property the reference's repair window lacks for
-    # in-flight messages (SURVEY.md §3.7).
-    d = os.path.dirname(os.path.abspath(path)) or "."
-    os.makedirs(d, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=d, suffix=".ckpt.tmp")
-    try:
-        with os.fdopen(fd, "wb") as f:
-            np.savez(f, **arrays)
-        os.replace(tmp, path)
-    except BaseException:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-        raise
+    # Write-then-fsync-then-rename so a crash mid-save never corrupts the
+    # previous checkpoint — the property the reference's repair window lacks
+    # for in-flight messages (SURVEY.md §3.7).
+    _atomic_write(path, lambda f: np.savez(f, **arrays))
 
 
 def meta(path: str) -> Dict[str, Any]:
@@ -112,3 +125,31 @@ def restore(path: str, template: Any, device_put: bool = True) -> Any:
     if device_put:
         out = jax.device_put(out)
     return out
+
+
+# ---------------------------------------------------------------------------
+# durable topic state (live-plane root failover, net/live.py)
+# ---------------------------------------------------------------------------
+
+
+def save_topic_state(path: str, state: Dict[str, Any]) -> None:
+    """Persist a live topic's control state ``{epoch, seq, successors,
+    roster, ...}`` atomically (same write-temp/fsync/rename discipline as
+    :func:`save`).  The payload is small JSON, not arrays: a restarted host
+    reads it before joining so it re-enters at the *current* epoch instead
+    of resurrecting a stale tree."""
+    doc = {"format_version": _TOPIC_STATE_VERSION, "state": state}
+    body = json.dumps(doc, sort_keys=True).encode("utf-8")
+    _atomic_write(path, lambda f: f.write(body))
+
+
+def load_topic_state(path: str) -> Dict[str, Any]:
+    """Read a topic-state file written by :func:`save_topic_state`."""
+    with open(path, "rb") as f:
+        doc = json.loads(f.read().decode("utf-8"))
+    if doc.get("format_version") != _TOPIC_STATE_VERSION:
+        raise ValueError(
+            f"topic state format {doc.get('format_version')} != "
+            f"supported {_TOPIC_STATE_VERSION}"
+        )
+    return doc["state"]

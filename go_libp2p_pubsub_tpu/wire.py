@@ -66,10 +66,25 @@ class Message:
     num_peers: int = 0
     # Repair-replay marker (this build's extension, net/live.py): a Data
     # frame re-sent to a re-adopted orphan because the adopter cannot know
-    # what the dead parent delivered.  Serialized only when set, so normal
-    # traffic stays byte-identical to the reference encoder; a Go peer's
-    # ``encoding/json`` ignores the unknown key on the frames that carry it.
+    # what the dead parent delivered.  On a Join it is a recovery request:
+    # "replay me your retained forward-log window after admitting me".
+    # Serialized only when set, so normal traffic stays byte-identical to
+    # the reference encoder; a Go peer's ``encoding/json`` ignores the
+    # unknown key on the frames that carry it.
     replay: bool = False
+    # Failover extensions (net/live.py root-failover):
+    # - ``epoch``: fencing counter; 0 (the whole pre-failover regime) is
+    #   omitted on the wire so clean-path frames stay byte-identical to the
+    #   reference encoder.  After a successor promotion every Data/Update
+    #   frame carries the new epoch and receivers reject lower values.
+    # - ``successors``: the root's rank-ordered successor list (its direct
+    #   children in admission order), piggybacked on Update frames.
+    # - ``roster``: the root's two-level membership view (direct children +
+    #   reported grandchildren), the electorate a successor quorum-probes
+    #   before promoting itself.
+    epoch: int = 0
+    successors: List[str] = field(default_factory=list)
+    roster: List[str] = field(default_factory=list)
 
     def to_json_obj(self) -> dict:
         # Field order matches the Go struct declaration order so encoded bytes
@@ -87,6 +102,12 @@ class Message:
             obj["numpeers"] = self.num_peers
         if self.replay:
             obj["replay"] = True
+        if self.epoch:
+            obj["epoch"] = self.epoch
+        if self.successors:
+            obj["successors"] = list(self.successors)
+        if self.roster:
+            obj["roster"] = list(self.roster)
         return obj
 
     @classmethod
@@ -100,6 +121,9 @@ class Message:
             tree_max_width=int(obj.get("treemaxwidth", 0)),
             num_peers=int(obj.get("numpeers", 0)),
             replay=bool(obj.get("replay", False)),
+            epoch=int(obj.get("epoch", 0)),
+            successors=list(obj.get("successors", []) or []),
+            roster=list(obj.get("roster", []) or []),
         )
 
 

@@ -313,3 +313,99 @@ def test_live_signed_batch_amortization(net):
     got = [sub.get(timeout=10.0) for _ in range(n)]
     assert got == [f"burst {i}".encode() for i in range(n)]
     assert sub.sub.validator.pipeline.stats["accepted"] == n
+
+
+# ---------------------------------------------------------------------------
+# Root failover: epoch-fenced re-rooting, durable topic state
+# ---------------------------------------------------------------------------
+
+from go_libp2p_pubsub_tpu.utils import checkpoint as ckpt
+from go_libp2p_pubsub_tpu.wire import Message, MessageType
+
+
+def _wait_promoted(subs, timeout=8.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for s in subs:
+            if s.is_promoted():
+                return s
+        time.sleep(0.05)
+    return None
+
+
+def test_live_root_kill_promotes_successor_and_resumes(net):
+    """Abrupt root death: a successor promotes under a bumped epoch, every
+    survivor converges on the SAME epoch, and publishes resume through the
+    promoted root."""
+    hosts, topic, subs = init_pubsub(net, 6)
+    check_system(topic, subs, None, 0)
+    hosts[0].close()  # no Part, no handover: the SPOF this PR removes
+    promoted = _wait_promoted(subs)
+    assert promoted is not None, "no successor promoted after root kill"
+    node = promoted.sub.node
+    assert node.is_root and node.epoch >= 1
+    settle_and_clear(subs, settle_s=0.5)
+    promoted.publish_message(b"after failover")
+    for s in subs:
+        if s is promoted:
+            continue
+        assert s.get(timeout=8.0) == b"after failover"
+    # Epoch agreement across every survivor — a fork here means two roots.
+    assert {s.sub.node.epoch for s in subs} == {node.epoch}
+
+
+def test_live_zombie_epoch_frames_fenced(net):
+    """Frames stamped with the dead regime's epoch are fenced out at every
+    survivor: a zombie root (or its buffered traffic) cannot fork the tree
+    after a promotion."""
+    hosts, topic, subs = init_pubsub(net, 5)
+    check_system(topic, subs, None, 0)
+    hosts[0].close()
+    promoted = _wait_promoted(subs)
+    assert promoted is not None
+    settle_and_clear(subs, settle_s=0.5)
+    survivor = next(s for s in subs if s is not promoted)
+    node = survivor.sub.node
+    assert node.epoch >= 1
+    before = net.registry.counters().get(
+        "live.failover.stale_epoch_rejected", 0)
+    assert node.fence_frame(
+        Message(type=MessageType.DATA, data=b"zombie", epoch=0)) is False
+    assert node.fence_frame(
+        Message(type=MessageType.DATA, data=b"ok", epoch=node.epoch)) is True
+    after = net.registry.counters().get(
+        "live.failover.stale_epoch_rejected", 0)
+    assert after == before + 1
+
+
+def test_live_checkpoint_records_promotion(net, tmp_path):
+    """Durable topic state: the root checkpoints its successor/roster view;
+    a promoted successor checkpoints the bumped epoch — a restart re-enters
+    at the current regime instead of resurrecting a stale tree."""
+    hosts = net.make_hosts(5)
+    topic = hosts[0].new_topic(
+        "foobar", checkpoint_path=str(tmp_path / "root.json"))
+    paths, subs = {}, []
+    for i, h in enumerate(hosts[1:], start=1):
+        paths[i] = str(tmp_path / f"peer{i}.json")
+        subs.append(h.subscribe(hosts[0].id, "foobar",
+                                checkpoint_path=paths[i]))
+    check_system(topic, subs, None, 0)
+    time.sleep(0.3)
+    st = ckpt.load_topic_state(str(tmp_path / "root.json"))
+    assert st["epoch"] == 0
+    assert st["successors"], "root checkpoint recorded no successors"
+    hosts[0].close()
+    promoted = _wait_promoted(subs)
+    assert promoted is not None
+    idx = subs.index(promoted) + 1
+    st2, deadline = None, time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        try:
+            st2 = ckpt.load_topic_state(paths[idx])
+            if st2["epoch"] >= 1:
+                break
+        except (FileNotFoundError, ValueError):
+            pass
+        time.sleep(0.1)
+    assert st2 is not None and st2["epoch"] >= 1

@@ -275,6 +275,47 @@ def test_canon_unknown_name():
 
 @pytest.mark.slow
 def test_canon_suite_all_green():
-    results = scenario.run_suite(scenario.build_all())
+    # Live-only canon (root failover, socket partition heal) has no sim
+    # lowering — the live acceptance tests in test_chaos.py grade those.
+    sim_specs = [s for s in scenario.build_all() if scenario.sim_supported(s)]
+    results = scenario.run_suite(sim_specs)
     failed = [r.verdict for r in results if not r.verdict.passed]
     assert not failed, "\n".join(str(v) for v in failed)
+
+
+def test_live_only_canon_flagged_and_filtered():
+    """The two live-only scenarios declare themselves out of the sim plane
+    (and in the live plane); everything else supports sim."""
+    for name in ("root_kill_failover", "live_partition_heal"):
+        s = scenario.build(name)
+        assert s.live_only
+        assert not scenario.sim_supported(s)
+        assert scenario.live_supported(s)
+    assert all(scenario.sim_supported(s)
+               for s in scenario.build_all()
+               if s.name not in ("root_kill_failover", "live_partition_heal"))
+
+
+def test_slo_failover_criteria():
+    """min_final_epoch / max_epoch_spread / max_duplicate_deliveries grade
+    from the failover channels and fail loudly when the channel is absent."""
+    spec = _small_spec(slo=SLO(min_final_epoch=1, max_epoch_spread=0,
+                               max_duplicate_deliveries=0))
+    T = spec.n_steps
+    record = {
+        "final_epoch": np.full(T, 1, np.int64),
+        "epoch_spread": np.zeros(T, np.int64),
+        "duplicate_deliveries": np.zeros(T, np.int64),
+    }
+    v = scenario.evaluate(spec, record, n_publishes=1)
+    assert v.passed
+    assert {c.name for c in v.criteria} >= {
+        "final_epoch", "epoch_spread", "duplicate_deliveries"}
+    # a forked tree (spread 1) or a double delivery flips the verdict red
+    record["epoch_spread"] = np.full(T, 1, np.int64)
+    assert not scenario.evaluate(spec, record, n_publishes=1).passed
+    record["epoch_spread"] = np.zeros(T, np.int64)
+    record["duplicate_deliveries"] = np.full(T, 2, np.int64)
+    assert not scenario.evaluate(spec, record, n_publishes=1).passed
+    with pytest.raises(ValueError, match="final_epoch"):
+        scenario.evaluate(spec, {}, n_publishes=1)

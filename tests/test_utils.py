@@ -1,6 +1,8 @@
 """Aux-subsystem tests: checkpoint round-trips, metrics reductions, fault
 plans, topology export (SURVEY.md §5)."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -90,6 +92,71 @@ class TestCheckpoint:
         checkpoint.save(p, st)
         with pytest.raises(ValueError, match="mismatch"):
             checkpoint.restore(p, {"only": jnp.zeros(3)})
+
+    def test_crash_mid_save_preserves_previous_checkpoint(self, tmp_path,
+                                                          monkeypatch):
+        """A writer that dies mid-save must leave the previous file intact
+        and byte-identical, and leak no temp files — the atomicity contract
+        ``_atomic_write`` exists for."""
+        p = str(tmp_path / "t.ckpt")
+        st = {"x": jnp.arange(6, dtype=jnp.int32)}
+        checkpoint.save(p, st, meta={"step": 1})
+        before = open(p, "rb").read()
+
+        real_savez = checkpoint.np.savez
+
+        def exploding_savez(f, **arrays):
+            real_savez(f, **arrays)  # bytes hit the temp file...
+            raise OSError("disk gone mid-save")  # ...then the crash
+
+        monkeypatch.setattr(checkpoint.np, "savez", exploding_savez)
+        with pytest.raises(OSError, match="mid-save"):
+            checkpoint.save(p, {"x": jnp.arange(6, dtype=jnp.int32) * 9},
+                            meta={"step": 2})
+        monkeypatch.undo()
+
+        assert open(p, "rb").read() == before
+        assert checkpoint.meta(p) == {"step": 1}
+        assert [f for f in os.listdir(tmp_path) if f.endswith(".tmp")] == []
+
+    def test_topic_state_roundtrip(self, tmp_path):
+        p = str(tmp_path / "topic.json")
+        state = {
+            "epoch": 3,
+            "seq": 41,
+            "successors": ["QmA", "QmB"],
+            "roster": ["QmA", "QmB", "QmC"],
+            "children": ["QmA"],
+        }
+        checkpoint.save_topic_state(p, state)
+        assert checkpoint.load_topic_state(p) == state
+
+    def test_topic_state_crash_mid_save(self, tmp_path, monkeypatch):
+        p = str(tmp_path / "topic.json")
+        checkpoint.save_topic_state(p, {"epoch": 1, "seq": 5})
+
+        real_atomic = checkpoint._atomic_write
+
+        def torn(path, write_fn):
+            def torn_fn(f):
+                write_fn(f)
+                raise OSError("power loss")
+            real_atomic(path, torn_fn)
+
+        monkeypatch.setattr(checkpoint, "_atomic_write", torn)
+        with pytest.raises(OSError, match="power loss"):
+            checkpoint.save_topic_state(p, {"epoch": 2, "seq": 6})
+        monkeypatch.undo()
+
+        assert checkpoint.load_topic_state(p) == {"epoch": 1, "seq": 5}
+        assert [f for f in os.listdir(tmp_path) if f.endswith(".tmp")] == []
+
+    def test_topic_state_version_gate(self, tmp_path):
+        p = str(tmp_path / "topic.json")
+        with open(p, "w") as f:
+            f.write('{"format_version": 99, "state": {"epoch": 1}}')
+        with pytest.raises(ValueError, match="format"):
+            checkpoint.load_topic_state(p)
 
 
 # ---------------------------------------------------------------------------

@@ -76,10 +76,67 @@ def test_omitempty_zero_values():
         Message(type=MessageType.UPDATE, peers=["a", "b"], tree_width=3, tree_max_width=7),
         Message(type=MessageType.STATE, peers=["x"], num_peers=41),
         Message(type=MessageType.PART),
+        Message(type=MessageType.DATA, data=b"x", epoch=3),
+        Message(
+            type=MessageType.UPDATE,
+            peers=["QmRoot"],
+            tree_width=2,
+            tree_max_width=5,
+            epoch=2,
+            successors=["QmA", "QmB"],
+            roster=["QmA", "QmB", "QmC"],
+        ),
+        Message(type=MessageType.JOIN, replay=True),
     ],
 )
 def test_roundtrip(m):
     assert decode_message(encode_message(m)) == m
+
+
+def test_epoch_zero_stays_byte_identical_to_reference():
+    # The whole pre-failover regime is epoch 0, and epoch 0 / empty
+    # successor and roster lists must vanish from the wire exactly like
+    # Go's omitempty — clean-path frames stay byte-identical to the
+    # reference encoder even though the dataclass grew failover fields.
+    m = Message(
+        type=MessageType.UPDATE,
+        peers=["QmPeer"],
+        tree_width=2,
+        tree_max_width=5,
+        epoch=0,
+        successors=[],
+        roster=[],
+    )
+    assert (
+        encode_message(m)
+        == b'{"Type":3,"parents":["QmPeer"],"treewidth":2,"treemaxwidth":5}\n'
+    )
+    assert encode_message(Message(type=MessageType.DATA, data=b"hi", epoch=0)) \
+        == b'{"Type":0,"data":"aGk="}\n'
+
+
+def test_epoch_and_successor_fields_serialize_after_replay():
+    # Declaration-order contract: the failover keys trail every reference
+    # key (and the replay extension), so a Go peer decoding the frame sees
+    # the known prefix unchanged and drops the unknown tail.
+    m = Message(
+        type=MessageType.UPDATE,
+        peers=["QmRoot"],
+        epoch=1,
+        successors=["QmA"],
+        roster=["QmA", "QmB"],
+    )
+    assert encode_message(m) == (
+        b'{"Type":3,"parents":["QmRoot"],"epoch":1,'
+        b'"successors":["QmA"],"roster":["QmA","QmB"]}\n'
+    )
+
+
+def test_decode_missing_failover_fields_defaults():
+    # A reference-era frame (no failover keys) decodes to epoch 0 and empty
+    # lists — absent epoch MEANS epoch 0 to the fence.
+    m = decode_message(b'{"Type":0,"data":"aGk="}')
+    assert m.epoch == 0 and m.successors == [] and m.roster == []
 
 
 def test_decode_go_style_input():

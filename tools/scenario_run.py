@@ -82,7 +82,12 @@ def main(argv: List[str] | None = None) -> int:
 
     if args.list:
         for name, builder in scenario.CANON.items():
-            print(f"{name:<26} {builder().description}")
+            s = builder()
+            planes = [p for p, ok in (
+                ("sim", scenario.sim_supported(s)),
+                ("live", scenario.live_supported(s)),
+            ) if ok]
+            print(f"{name:<26} {'+'.join(planes):<8} {s.description}")
         return 0
 
     if args.replay:
@@ -124,6 +129,14 @@ def main(argv: List[str] | None = None) -> int:
         specs = [s for s in specs if scenario.live_supported(s)]
         if skipped:
             print(f"# live plane: skipping unsupported canon: "
+                  f"{', '.join(skipped)}", file=sys.stderr)
+    if args.plane == "sim" and not args.names and not args.spec:
+        # Mirror filter: live-only canon (root failover, socket partition
+        # heal) has no device lowering and is skipped from the sim sweep.
+        skipped = [s.name for s in specs if not scenario.sim_supported(s)]
+        specs = [s for s in specs if scenario.sim_supported(s)]
+        if skipped:
+            print(f"# sim plane: skipping live-only canon: "
                   f"{', '.join(skipped)}", file=sys.stderr)
 
     results = []
