@@ -32,7 +32,7 @@ from ..ops import bitpack
 from ..ops import gossip_packed as gossip_ops
 from ..ops import histogram as hist_ops
 from ..ops import scoring as scoring_ops
-from ..ops.gossip import heartbeat_mesh
+from ..ops.gossip import heartbeat_mesh, uniform_by_uid
 from ..ops.graphs import safe_gather, top_mask
 from ..ops.px import px_rewire
 from ..ops.scoring import GlobalCounters, TopicCounters
@@ -168,6 +168,16 @@ def build_topology_fast(
     dialer = np.where(
         rng.integers(0, 2, len(e)).astype(bool), e[:, 0], e[:, 1]
     )
+    return _assign_slots(e, dialer, n, k)
+
+
+def _assign_slots(
+    e: np.ndarray, dialer: np.ndarray, n: int, k: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Deduped undirected edge list -> slot-form (nbrs, rev, nbr_valid,
+    outbound).  Shared tail of the vectorized builders: per-endpoint slot
+    indices via cumulative counts, edges overflowing k dropped (BOTH
+    directions must get a slot), rev back-pointers paired by edge id."""
     # Per-endpoint slot indices via cumulative counts; drop edges overflowing k.
     src = np.concatenate([e[:, 0], e[:, 1]])
     dst = np.concatenate([e[:, 1], e[:, 0]])
@@ -200,6 +210,58 @@ def build_topology_fast(
     rev_sorted[o2] = slot_s[o2].reshape(-1, 2)[:, ::-1].reshape(-1)
     rev[src_s, slot_s] = rev_sorted
     return nbrs, rev, nbrs >= 0, outbound
+
+
+def build_topology_local(
+    rng: np.random.Generator, n: int, k: int, degree: int,
+    spread: Optional[int] = None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Locality-structured ~degree-regular graph: each peer's edges land
+    within ring distance ``spread`` (default n // 32) of it — the model of
+    geographic peer clustering real P2P overlays exhibit, where a node's
+    connections skew heavily toward its own region.
+
+    The emitted peer ids are RANDOMLY RELABELED inside the builder, so the
+    locality is invisible to id order: a sharded runner that wants the cut
+    win must genuinely rediscover the clusters (``parallel/placement``).
+    Contrast ``build_topology_fast``: a union of uniform pairings is an
+    expander with no good balanced partition — locality-aware placement can
+    only help on a graph that has locality, and this builder is the
+    fixed-seed bench mesh's source of it.
+
+    Dissemination still converges quickly: the uniform [1, spread] ring
+    offsets advance an epidemic frontier ~spread peers per round, so the
+    graph's effective diameter is ~n / (2 * spread) rounds (~16 at the
+    default spread), not the n / (2k) of a nearest-neighbor ring.
+    """
+    if degree >= k:
+        raise ValueError(f"degree ({degree}) must be < slot count k ({k})")
+    if degree == 0 or n < 4:
+        empty = np.full((n, k), -1, np.int64)
+        return empty, empty.copy(), empty >= 0, np.zeros((n, k), bool)
+    if spread is None:
+        spread = max(4, n // 32)
+    spread = int(min(spread, max(1, n // 2 - 1)))
+    # Each peer proposes degree/2 edges (every undirected edge serves two
+    # endpoints), at a uniform ring offset in [1, spread], either direction.
+    src = np.tile(np.arange(n, dtype=np.int64), degree // 2)
+    if degree % 2:
+        src = np.concatenate(
+            [src, rng.choice(n, n // 2, replace=False).astype(np.int64)]
+        )
+    delta = rng.integers(1, spread + 1, size=src.shape[0])
+    sign = np.where(rng.integers(0, 2, src.shape[0]) > 0, 1, -1)
+    dst = (src + delta * sign) % n
+    e = np.stack([np.minimum(src, dst), np.maximum(src, dst)], 1)
+    e = np.unique(e[src != dst], axis=0)
+    # Hide the ring: relabel every id through a random permutation, then
+    # re-canonicalize the pairs.  Same-seed runs stay reproducible (one rng).
+    sigma = rng.permutation(n).astype(np.int64)
+    e = np.sort(np.stack([sigma[e[:, 0]], sigma[e[:, 1]]], 1), axis=1)
+    dialer = np.where(
+        rng.integers(0, 2, len(e)).astype(bool), e[:, 0], e[:, 1]
+    )
+    return _assign_slots(e, dialer, n, k)
 
 
 def compute_edge_live(
@@ -266,6 +328,8 @@ class GossipSub:
         max_edge_delay: int = 0,
         pallas_shard_mesh=None,
         direct_edges: Optional[np.ndarray] = None,
+        peer_uid: Optional[np.ndarray] = None,
+        split_gather_mesh=None,
     ):
         self.n = n_peers
         self.k = n_slots
@@ -319,6 +383,26 @@ class GossipSub:
             use_pallas = jax.default_backend() == "tpu"
         self.use_pallas = use_pallas
         self.pallas_shard_mesh = pallas_shard_mesh
+        # Canonical-id vector for placement-relabeled runs
+        # (``parallel/placement``): ``peer_uid[i]`` is physical row i's
+        # canonical peer id.  Every per-peer RNG draw routes through it
+        # (``ops.gossip.uniform_by_uid``) so the relabeled rollout is
+        # bit-identical to the canonical one under the inverse permutation.
+        # None (the identity) keeps every kernel byte-for-byte unchanged.
+        if peer_uid is None:
+            self.peer_uid = None
+        else:
+            pu = np.asarray(peer_uid)
+            if pu.shape != (n_peers,):
+                raise ValueError(f"peer_uid must be [N={n_peers}]")
+            if not np.array_equal(np.sort(pu), np.arange(n_peers)):
+                raise ValueError("peer_uid must be a permutation of 0..N-1")
+            self.peer_uid = jnp.asarray(pu, jnp.int32)
+        # Split-gather fast path (``ops.gossip_packed.ring_gather_rows``):
+        # a Mesh with a "peers" axis routes the jnp packed row gathers
+        # through shard-local indexing + an overlapped ppermute ring instead
+        # of one monolithic all-shard gather.
+        self.split_gather_mesh = split_gather_mesh
 
     # Value semantics for the jit cache: the model is a pure function of
     # its configuration, so two identically-configured instances may share
@@ -327,7 +411,11 @@ class GossipSub:
     # recompiles the full scan body.  Instances carrying non-value extras
     # (a custom topology builder, a shard mesh) fall back to identity.
     def _config_key(self):
-        if self.builder is not None or self.pallas_shard_mesh is not None:
+        if (
+            self.builder is not None
+            or self.pallas_shard_mesh is not None
+            or self.split_gather_mesh is not None
+        ):
             return id(self)
         return (
             type(self), self.n, self.k, self.m, self.conn_degree,
@@ -337,6 +425,8 @@ class GossipSub:
             else bytes(np.asarray(self.graft_spammers)),
             None if self.direct_edges is None
             else bytes(np.packbits(np.asarray(self.direct_edges))),
+            None if self.peer_uid is None
+            else bytes(np.asarray(self.peer_uid)),
         )
 
     def __eq__(self, other):
@@ -409,7 +499,15 @@ class GossipSub:
             fanout_age=jnp.full((n,), jnp.iinfo(jnp.int32).max // 2, jnp.int32),
             backoff=jnp.zeros((n, k), jnp.int32),
             counters=TopicCounters.zeros(n, k),
-            gcounters=GlobalCounters.zeros(n),
+            # Default colocation groups are identity labels (one group per
+            # peer); under a placement relabeling the label must follow the
+            # CANONICAL identity, not the physical row, for the relabeled
+            # rollout to stay bit-identical (values are compared by group
+            # membership only, so unique-per-peer semantics are unchanged).
+            gcounters=(
+                GlobalCounters.zeros(n) if self.peer_uid is None
+                else GlobalCounters.zeros(n)._replace(ip_group=self.peer_uid)
+            ),
             scores=jnp.zeros((n, k), jnp.float32),
             have_w=jnp.zeros((n, w), jnp.uint32),
             fresh_w=jnp.zeros((n, w), jnp.uint32),
@@ -682,7 +780,7 @@ class GossipSub:
         fadd = top_mask(
             jnp.where(
                 feligible & ~fkeep,
-                jax.random.uniform(key, (self.n, self.k)),
+                uniform_by_uid(key, (self.n, self.k), self.peer_uid),
                 -jnp.inf,
             ),
             fwant,
@@ -718,6 +816,7 @@ class GossipSub:
             st.backoff, st.outbound, do_og,
             og_threshold=sp.opportunistic_graft_threshold,
             ignore_backoff=self.graft_spammers,
+            uid=self.peer_uid,
         )
         c = scoring_ops.on_prune(c, pruned, sp)
         c = scoring_ops.on_graft(c, grafted)
@@ -734,6 +833,7 @@ class GossipSub:
         px = px_rewire(
             kpx, st.nbrs, st.rev, st.nbr_valid, st.outbound, backoff,
             new_mesh, pruned, scores, st.alive, sp.accept_px_threshold,
+            uid=self.peer_uid,
         )
         edge_live, nbr_sub = jax.lax.cond(
             px.connected.any(),
@@ -789,10 +889,13 @@ class GossipSub:
             iwant_pend_w, broken = gossip_exchange_packed_pallas(
                 *exchange_args, interpret=jax.default_backend() != "tpu",
                 device_mesh=self.pallas_shard_mesh,
+                uid=self.peer_uid,
             )
         else:
             iwant_pend_w, broken = gossip_ops.gossip_exchange_packed(
-                *exchange_args
+                *exchange_args,
+                uid=self.peer_uid,
+                device_mesh=self.split_gather_mesh,
             )
         # P7: broken promises charge the ADVERTISER (indexed by remote id).
         promise_ids = jnp.where(
@@ -938,6 +1041,7 @@ class GossipSub:
                 relay_mesh, st.nbrs, st.edge_live, st.alive, have_w,
                 st.fresh_w, valid_w, fresh_src=fresh_src,
                 idontwant=idontwant, idw_have_w=idw,
+                device_mesh=self.split_gather_mesh,
             )
         # One [N, M] stamping pass for both receipt sources (pend fold +
         # eager push): both record the same step, so the union stamps once.

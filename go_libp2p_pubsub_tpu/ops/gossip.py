@@ -44,6 +44,27 @@ from ..config import GossipSubParams
 from .graphs import safe_gather, top_mask
 
 
+def uniform_by_uid(
+    key: jax.Array,
+    shape: Tuple[int, ...],
+    uid: Optional[jax.Array],
+    minval: float = 0.0,
+    maxval: float = 1.0,
+) -> jax.Array:
+    """Per-peer uniform draw keyed on canonical peer identity.
+
+    Row axis 0 of the draw is peer id; when the caller runs under a
+    renumbering (``parallel/placement``), ``uid[i]`` is physical row i's
+    canonical id and the draw is gathered through it — so the randomness a
+    peer sees depends on WHO it is, not where the placement put it, and a
+    relabeled rollout stays bit-identical to the canonical one under the
+    inverse permutation.  ``uid=None`` (identity) is the everyday path and
+    compiles to exactly the plain draw.
+    """
+    r = jax.random.uniform(key, shape, minval=minval, maxval=maxval)
+    return r if uid is None else r[uid]
+
+
 class PropagateOut(NamedTuple):
     have: jax.Array
     fresh: jax.Array
@@ -130,6 +151,7 @@ def gossip_emission_mask(
     scores: jax.Array,      # f32[N, K]
     p: GossipSubParams,
     gossip_threshold: float,
+    uid: Optional[jax.Array] = None,  # i32[N] canonical id per physical row
 ) -> jax.Array:
     """bool[N, K]: the neighbor slots each peer advertises to this heartbeat.
 
@@ -146,7 +168,7 @@ def gossip_emission_mask(
     emit = jnp.maximum(
         jnp.int32(d_lazy), jnp.ceil(p.gossip_factor * n_eligible).astype(jnp.int32)
     )
-    r = jax.random.uniform(key, (n, k))
+    r = uniform_by_uid(key, (n, k), uid)
     return top_mask(jnp.where(eligible, r, -jnp.inf), emit, kmax=k)
 
 
@@ -181,6 +203,7 @@ def ihave_advertise(
     gossip_msgs: jax.Array,  # bool[M] advertisable window (valid & recent)
     p: GossipSubParams,
     gossip_threshold: float,
+    uid: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Heartbeat IHAVE phase -> adv bool[N, K, M]: ``adv[i, s]`` is the set of
     message ids advertised TO peer i BY its neighbor slot s this heartbeat.
@@ -197,7 +220,7 @@ def ihave_advertise(
     """
     n, k = nbrs.shape
     chosen = gossip_emission_mask(
-        key, mesh, edge_live, alive, scores, p, gossip_threshold
+        key, mesh, edge_live, alive, scores, p, gossip_threshold, uid
     )
     jidx = jnp.clip(nbrs, 0, n - 1)
     ridx = jnp.clip(rev, 0, k - 1)
@@ -206,14 +229,16 @@ def ihave_advertise(
     return cap_ihave(adv, p.max_ihave_length)
 
 
-def iwant_priority(key: jax.Array, n: int, k: int) -> Tuple[jax.Array, jax.Array]:
+def iwant_priority(
+    key: jax.Array, n: int, k: int, uid: Optional[jax.Array] = None
+) -> Tuple[jax.Array, jax.Array]:
     """Per-heartbeat random advertiser priority -> (perm, inv), both i32[N, K].
 
     ``perm[i]`` is a keyed random order of peer i's slots; ``inv`` is its
     inverse.  Shared by the packed and unpacked IWANT kernels so the two
     stay bit-exact under the same key.
     """
-    r = jax.random.uniform(key, (n, k))
+    r = uniform_by_uid(key, (n, k), uid)
     perm = jnp.argsort(r, axis=1).astype(jnp.int32)
     inv = jnp.argsort(perm, axis=1).astype(jnp.int32)
     return perm, inv
@@ -229,6 +254,7 @@ def iwant_select(
     alive: jax.Array,      # bool[N]
     max_iwant_length: int,
     gossip_threshold: float,
+    uid: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """IWANT phase with promise accounting -> (pend bool[N, M],
     broken f32[N, K]).
@@ -259,7 +285,7 @@ def iwant_select(
     n, k = edge_live.shape
     accept = edge_live & (scores >= gossip_threshold)
     want = adv & ~have[:, None, :] & accept[:, :, None]
-    perm, inv = iwant_priority(key, n, k)
+    perm, inv = iwant_priority(key, n, k, uid)
     want_p = jnp.take_along_axis(want, perm[:, :, None], axis=1)
     prefix = jnp.cumsum(want_p.astype(jnp.int32), axis=1)
     first_p = want_p & (prefix == 1)           # one advertiser per id, random order
@@ -298,6 +324,7 @@ def heartbeat_mesh(
     do_opportunistic=False,  # bool scalar: opportunistic-graft tick
     og_threshold: float = 1.0,  # ScoreParams.opportunistic_graft_threshold
     ignore_backoff: Optional[jax.Array] = None,  # bool[N] misbehaviour model
+    uid: Optional[jax.Array] = None,  # i32[N] canonical id per physical row
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """Mesh maintenance: prune negative-score and over-degree links, graft
     toward D from well-scored candidates, then symmetrize edge state.
@@ -361,7 +388,7 @@ def heartbeat_mesh(
     # eclipse vector the random fill exists to break), then enforce the
     # outbound quota: if fewer than d_out of the chosen are outbound, swap
     # random non-outbound fills for kept outbound slots.
-    noise = jax.random.uniform(kkeep, (n, k), minval=0.0, maxval=1e-3)
+    noise = uniform_by_uid(kkeep, (n, k), uid, minval=0.0, maxval=1e-3)
     best = top_mask(jnp.where(keep, scores + noise, -jnp.inf), p.d_score)
     fill = top_mask(
         jnp.where(keep & ~best, noise, -jnp.inf), max(p.d - p.d_score, 0)
@@ -399,7 +426,7 @@ def heartbeat_mesh(
         bo_ok | ignore_backoff[:, None]
     )
     cand = kmask & ~keep & score_ok & cand_bo
-    r = jax.random.uniform(kgraft, (n, k))
+    r = uniform_by_uid(kgraft, (n, k), uid)
     want_more = jnp.where(
         deg_now < p.d_lo, jnp.maximum(p.d - deg_now, 0), 0
     ).astype(jnp.int32)
@@ -427,7 +454,7 @@ def heartbeat_mesh(
             og_want = jnp.where(
                 med < og_threshold, p.opportunistic_graft_peers, 0
             ).astype(jnp.int32)
-            rog = jax.random.uniform(kog, (n, k))
+            rog = uniform_by_uid(kog, (n, k), uid)
             return graft | top_mask(
                 jnp.where(
                     cand & ~graft & (scores > med[:, None]), rog, -jnp.inf

@@ -25,16 +25,79 @@ the portable reference the kernel is tested against.
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 
 from ..config import GossipSubParams
+from . import bitpack
 from .gossip import gossip_emission_mask, iwant_priority
 from .graphs import top_mask
 
 FULL = jnp.uint32(0xFFFFFFFF)
+
+
+def ring_gather_rows(
+    table: jax.Array,       # [N, ...] row-sharded source
+    idx: jax.Array,         # i32[N, K] row indices into ``table``
+    device_mesh,            # jax.sharding.Mesh with axis ``axis``
+    axis: str = "peers",
+) -> jax.Array:
+    """``table[idx]`` as shard-local indexing + a double-buffered ppermute
+    ring — the sharded rollout's split-gather fast path.
+
+    The monolithic GSPMD lowering of ``table[idx]`` all-gathers the full
+    table to every device before indexing: O(n_devices) memory traffic per
+    device regardless of how few rows actually cross shards.  Here round r
+    has device d hold the block owned by shard (d + r) and resolve exactly
+    the indices that land in it:
+
+    - round 0 is the INTRA-shard half — pure local indexing, no
+      communication at all.  A locality-aware placement
+      (``parallel/placement``) makes this round resolve most rows.
+    - rounds 1..n_sh-1 are the CROSS-shard half.  The next block is pushed
+      into flight (``ppermute``) BEFORE the current block's gather runs, so
+      each round's interconnect transfer overlaps the previous round's
+      local compute — double buffering, never more than one extra block
+      resident.
+
+    Requires N % n_shards == 0 (the peer-dim sharding's own precondition).
+    Bit-identical to ``table[idx]`` for in-range indices; out-of-range
+    clipped like the callers' ``jnp.clip`` convention.
+    """
+    from .shard_compat import shard_map_compat
+
+    P = jax.sharding.PartitionSpec
+    n_sh = device_mesh.shape[axis]
+    n = table.shape[0]
+    if n % n_sh != 0:
+        raise ValueError(f"rows ({n}) must divide device count ({n_sh})")
+    blk = n // n_sh
+    pairs = [((d + 1) % n_sh, d) for d in range(n_sh)]
+
+    def local(table_l, idx_l):
+        d = jax.lax.axis_index(axis)
+        out = jnp.zeros(idx_l.shape + table_l.shape[1:], table_l.dtype)
+        buf = table_l
+        for r in range(n_sh):
+            if r + 1 < n_sh:  # push next block into flight first
+                nxt = jax.lax.ppermute(buf, axis, pairs)
+            owner = (d + r) % n_sh
+            loc = idx_l - owner * blk
+            hit = (loc >= 0) & (loc < blk)
+            rows = buf[jnp.clip(loc, 0, blk - 1)]
+            shape_up = hit.reshape(hit.shape + (1,) * (rows.ndim - hit.ndim))
+            out = jnp.where(shape_up, rows, out)
+            if r + 1 < n_sh:
+                buf = nxt
+        return out
+
+    row = P(axis)
+    f = shard_map_compat(
+        local, device_mesh, in_specs=(row, row), out_specs=row
+    )
+    return f(table, idx)
 
 
 def _as_mask(b: jax.Array) -> jax.Array:
@@ -84,6 +147,9 @@ def propagate_packed(
     idw_have_w=None,       # u32[N, W] pre-fold possession snapshot the
                            # IDONTWANT notifications reflect; defaults to
                            # have_w (see gossip.propagate's idw_have)
+    device_mesh=None,      # split-gather fast path: resolve the fresh-plane
+                           # row gather via ring_gather_rows on this mesh
+    axis: str = "peers",
 ) -> PropagatePackedOut:
     """One eager-push round over packed windows.
 
@@ -95,7 +161,12 @@ def propagate_packed(
 
     j = jnp.clip(nbrs, 0, n - 1)
     edge_ok = mesh & edge_live                                     # bool[N, K]
-    src = fresh_w[j] if fresh_src is None else fresh_src
+    if fresh_src is not None:
+        src = fresh_src
+    elif device_mesh is not None:
+        src = ring_gather_rows(fresh_w, j, device_mesh, axis)
+    else:
+        src = fresh_w[j]
     inc = _as_mask(edge_ok)[:, :, None] & src                      # u32[N, K, W]
 
     before = exclusive_or_scan(inc, axis=1)
@@ -145,6 +216,7 @@ def ihave_advertise_packed(
     gossip_w: jax.Array,   # u32[W] packed advertisable window (valid & recent)
     p: GossipSubParams,
     gossip_threshold: float,
+    uid: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Heartbeat IHAVE phase over packed windows -> adv u32[N, K, W]:
     ``adv[i, s]`` is what neighbor slot s advertised TO peer i.
@@ -161,7 +233,7 @@ def ihave_advertise_packed(
             (n, k, have_w.shape[1]), jnp.uint32
         )
     chosen = gossip_emission_mask(
-        key, mesh, edge_live, alive, scores, p, gossip_threshold
+        key, mesh, edge_live, alive, scores, p, gossip_threshold, uid
     )
     # Target side: neighbor j = nbrs[t, s] chose me iff chosen[j, rev[t, s]].
     jidx = jnp.clip(nbrs, 0, n - 1)
@@ -181,6 +253,7 @@ def iwant_select_packed(
     alive: jax.Array,      # bool[N]
     max_iwant_length: int,
     gossip_threshold: float,
+    uid: Optional[jax.Array] = None,
 ) -> tuple[jax.Array, jax.Array]:
     """IWANT phase with promise accounting over packed windows ->
     (pend u32[N, W], broken f32[N, K]).
@@ -195,7 +268,7 @@ def iwant_select_packed(
     n, k = edge_live.shape
     accept = edge_live & (scores >= gossip_threshold)
     want = adv_w & ~have_w[:, None, :] & _as_mask(accept)[:, :, None]
-    perm, inv = iwant_priority(key, n, k)
+    perm, inv = iwant_priority(key, n, k, uid)
     # ONE [N,K,W] cube gather into priority order; everything downstream
     # stays permuted.  The ask cap is per-slot (order-independent), ``pend``
     # is an OR over slots (order-independent), and only the [N,K] ``broken``
@@ -235,6 +308,9 @@ def gossip_exchange_packed(
     gossip_threshold: float,
     serve_ok: jax.Array,     # bool[N, K]
     max_iwant_length: int,
+    uid: Optional[jax.Array] = None,
+    device_mesh=None,        # split-gather fast path (see ring_gather_rows)
+    axis: str = "peers",
 ) -> tuple[jax.Array, jax.Array]:
     """Fused IHAVE advertise + IWANT select -> (pend u32[N, W],
     broken f32[N, K]).
@@ -247,6 +323,12 @@ def gossip_exchange_packed(
     cube of the unfused pair (~51 MB at 100k peers) never materializes.
     The heartbeat's hot path; the unfused pair remains the tested
     reference.
+
+    With ``device_mesh`` the phase needs TWO remote lookups per slot — the
+    advertisement row ``(have & gossip)[j]`` and the chooser bit
+    ``chosen[j, rev]`` — so ``chosen`` is bit-packed and CONCATENATED onto
+    the row table: one ring gather serves both, and the cross-shard half
+    still overlaps the intra-shard compute (``ring_gather_rows``).
     """
     n, k = nbrs.shape
     d_lazy = min(p.d_lazy, k)
@@ -256,18 +338,29 @@ def gossip_exchange_packed(
             jnp.zeros((n, k), jnp.float32),
         )
     chosen = gossip_emission_mask(
-        key_adv, mesh, edge_live, alive, scores, p, gossip_threshold
+        key_adv, mesh, edge_live, alive, scores, p, gossip_threshold, uid
     )
-    perm, inv = iwant_priority(key_iwant, n, k)
+    perm, inv = iwant_priority(key_iwant, n, k, uid)
     take = lambda x: jnp.take_along_axis(x, perm, axis=1)
     jidx_p = take(jnp.clip(nbrs, 0, n - 1))
     ridx_p = take(jnp.clip(rev, 0, k - 1))
     edge_live_p = take(edge_live)
-    towards_me_p = chosen[jidx_p, ridx_p] & edge_live_p
-    adv_p = (
-        _as_mask(towards_me_p)[:, :, None]
-        & (have_w & gossip_w[None, :])[jidx_p]
-    )
+    if device_mesh is None:
+        towards_me_p = chosen[jidx_p, ridx_p] & edge_live_p
+        rows_p = (have_w & gossip_w[None, :])[jidx_p]
+    else:
+        w = have_w.shape[1]
+        table = jnp.concatenate(
+            [have_w & gossip_w[None, :], bitpack.pack(chosen)], axis=1
+        )
+        g = ring_gather_rows(table, jidx_p, device_mesh, axis)
+        rows_p = g[..., :w]
+        ch_words = jnp.take_along_axis(
+            g[..., w:], (ridx_p // 32)[:, :, None], axis=2
+        )[..., 0]
+        ch_bit = (ch_words >> (ridx_p % 32).astype(jnp.uint32)) & 1
+        towards_me_p = (ch_bit > 0) & edge_live_p
+    adv_p = _as_mask(towards_me_p)[:, :, None] & rows_p
     adv_p = cap_ihave_packed(adv_p, p.max_ihave_length)
     accept_p = edge_live_p & (take(scores) >= gossip_threshold)
     want_p = (

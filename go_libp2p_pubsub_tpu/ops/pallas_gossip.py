@@ -310,6 +310,7 @@ def gossip_exchange_packed_pallas(
     device_mesh=None,        # jax.sharding.Mesh: run the kernel under
                              # shard_map over ``axis`` (peer-sharded sim)
     axis: str = "peers",
+    uid=None,                # i32[N] canonical id per physical row (placement)
 ) -> tuple[jax.Array, jax.Array]:
     """Fused-kernel form of ``gossip_packed.gossip_exchange_packed`` — the
     heartbeat's IHAVE advertise + IWANT select in one Pallas pass.
@@ -345,9 +346,9 @@ def gossip_exchange_packed_pallas(
         )
 
     chosen = gossip_emission_mask(
-        key_adv, mesh, edge_live, alive, scores, p, gossip_threshold
+        key_adv, mesh, edge_live, alive, scores, p, gossip_threshold, uid
     )
-    perm, inv = iwant_priority(key_iwant, n, k)
+    perm, inv = iwant_priority(key_iwant, n, k, uid)
     take = lambda x: jnp.take_along_axis(x, perm, axis=1)
     jidx_p = take(jnp.clip(nbrs, 0, n - 1))
     ridx_p = take(jnp.clip(rev, 0, k - 1))
@@ -370,15 +371,15 @@ def gossip_exchange_packed_pallas(
         interpret=interpret,
     )
     if device_mesh is not None:
-        from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
+        from .shard_compat import shard_map_compat
+
         rows = P(axis, None)
-        call = shard_map(
-            call, mesh=device_mesh,
+        call = shard_map_compat(
+            call, device_mesh,
             in_specs=(rows, rows, rows, rows, rows),
             out_specs=(rows, rows),
-            check_vma=False,
         )
     pend, broken_p = call(adv_p, have_dedup_w, accept_l, serve_l, alive_m)
     broken = jnp.take_along_axis(broken_p, inv, axis=1)
@@ -449,8 +450,9 @@ def propagate_packed_pallas_sharded(
     peer-sharded on dim 0) is passed straight through and no all-gather is
     needed.
     """
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from .shard_compat import shard_map_compat
 
     n = nbrs.shape[0]
     rows = P(axis, None)
@@ -484,8 +486,7 @@ def propagate_packed_pallas_sharded(
         args = (mesh, nbrs, edge_live, alive, have_w, fresh_w, valid_w,
                 fresh_src, idw)
 
-    f = shard_map(
-        local, mesh=device_mesh, in_specs=in_specs, out_specs=out_specs,
-        check_vma=False,
+    f = shard_map_compat(
+        local, device_mesh, in_specs=in_specs, out_specs=out_specs,
     )
     return f(*args)

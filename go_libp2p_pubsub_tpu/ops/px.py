@@ -27,10 +27,12 @@ hot path.
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+
+from .gossip import uniform_by_uid
 
 
 class PxOut(NamedTuple):
@@ -54,6 +56,7 @@ def px_rewire(
     scores: jax.Array,     # f32[N, K]
     alive: jax.Array,      # bool[N]
     accept_px_threshold: float,
+    uid: Optional[jax.Array] = None,  # i32[N] canonical id per physical row
 ) -> PxOut:
     """One PX round: each pruned peer may open one new connection to a
     random mesh neighbor of its pruner.  Returns the rewired adjacency."""
@@ -73,7 +76,7 @@ def px_rewire(
     # Candidate m: a uniformly random CURRENT mesh neighbor of the pruner
     # (the spec's "sample of my mesh" in the PRUNE).
     mesh_j = mesh[j_sel]                                   # bool[N, K] row gather
-    rnd = jax.random.uniform(key, (n, k))
+    rnd = uniform_by_uid(key, (n, k), uid)
     cand_slot = jnp.argmax(jnp.where(mesh_j, rnd, -jnp.inf), axis=1)
     has_cand = mesh_j.any(axis=1)
     m = jidx[j_sel, cand_slot.astype(jnp.int32)]           # i32[N]
@@ -96,11 +99,15 @@ def px_rewire(
     init = init & (free_cnt[m] > 0)
 
     # One initiator per acceptor: scatter-min of initiator ids onto targets.
+    # The min runs over CANONICAL ids (uid) so the winning initiator is the
+    # same peer under any renumbering — raw physical ids would pick a
+    # placement-dependent winner and break relabeling equivariance.
+    uid_vals = peer_ids if uid is None else uid.astype(jnp.int32)
     tgt = jnp.where(init, m, n)
     winner = (
-        jnp.full((n + 1,), n, jnp.int32).at[tgt].min(peer_ids, mode="drop")
+        jnp.full((n + 1,), n, jnp.int32).at[tgt].min(uid_vals, mode="drop")
     )
-    win = init & (winner[tgt] == peer_ids)
+    win = init & (winner[tgt] == uid_vals)
 
     # Slot assignment: first free slot on each side.
     fi = jnp.argmax(~nbr_valid, axis=1).astype(jnp.int32)  # mine

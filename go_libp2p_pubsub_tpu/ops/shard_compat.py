@@ -1,0 +1,31 @@
+"""``shard_map`` across jax versions.
+
+The explicit-collective kernels (``pallas_gossip``'s shard_map wrappers, the
+ring-gather fast path in ``gossip_packed``) need ``shard_map`` with replication
+checking off — the kernels use ``axis_index``/``ppermute`` in ways the checker
+rejects.  The API moved twice: modern jax exports ``jax.shard_map`` taking
+``check_vma=``; 0.4.x has ``jax.experimental.shard_map.shard_map`` taking
+``check_rep=``.  This shim resolves whichever exists at call time so the same
+kernel source runs on both.
+"""
+
+from __future__ import annotations
+
+
+def shard_map_compat(f, mesh, in_specs, out_specs):
+    """``shard_map(f)`` with replication checking disabled, on whichever
+    shard_map API this jax build ships."""
+    try:
+        from jax import shard_map as sm
+
+        return sm(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    except (ImportError, TypeError):
+        from jax.experimental.shard_map import shard_map as sm
+
+        return sm(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )

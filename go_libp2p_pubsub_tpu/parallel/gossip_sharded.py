@@ -32,10 +32,16 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..models.gossipsub import GossipState, GossipSub
+from ..models.gossipsub import (
+    GossipState, GossipSub, build_topology, build_topology_fast,
+)
 from .mesh import PEER_AXIS, make_mesh
+from .placement import (
+    partition_bfs, placement_report, random_placement, relabel_topology,
+)
 
 
 # Field-name classification of GossipState's sharding layout.  By NAME, not
@@ -110,6 +116,8 @@ class ShardedGossipSub:
         n_peers: int,
         n_devices: Optional[int] = None,
         mesh: Optional[Mesh] = None,
+        placement: Optional[str] = None,
+        split_gather: bool = False,
         **gossip_kwargs,
     ):
         # use_pallas=True routes the eager round through the shard_map-
@@ -118,14 +126,32 @@ class ShardedGossipSub:
         # peer block — the 100k-peer sharded sim gets the fast kernel
         # instead of being forced onto the jnp path (r4 verdict item 4).
         # Default stays False (the GSPMD-partitioned jnp path).
-        use_pallas = bool(gossip_kwargs.pop("use_pallas", False))
+        #
+        # placement: None keeps id-order peer assignment; "bfs" renumbers
+        # peers at init so most mesh edges land intra-shard
+        # (``placement.partition_bfs``); "random" is the edge-cut baseline.
+        # Either way the rollout is bit-identical to the unplaced model
+        # under the inverse permutation (``self.inv``) — the model's
+        # ``peer_uid`` keys every RNG draw on canonical identity.  Publish
+        # sources and kill masks keep CANONICAL ids at this API; the
+        # translation happens here.
+        #
+        # split_gather: route the jnp packed row gathers through
+        # shard-local indexing + an overlapped ppermute ring
+        # (``gossip_packed.ring_gather_rows``) instead of one monolithic
+        # all-shard gather — the fast path placement exists to feed.
+        if placement not in (None, "bfs", "random"):
+            raise ValueError(f"unknown placement: {placement!r}")
+        self._use_pallas = bool(gossip_kwargs.pop("use_pallas", False))
         self.mesh = mesh if mesh is not None else make_mesh(n_devices)
-        self.model = GossipSub(
-            n_peers=n_peers,
-            use_pallas=use_pallas,
-            pallas_shard_mesh=self.mesh if use_pallas else None,
-            **gossip_kwargs,
-        )
+        self.placement = placement
+        self.split_gather = bool(split_gather)
+        self._n = n_peers
+        self._gossip_kwargs = dict(gossip_kwargs)
+        self.perm: Optional[np.ndarray] = None
+        self.inv: Optional[np.ndarray] = None
+        self.placement_report: Optional[dict] = None
+        self.model = self._make_model(builder=gossip_kwargs.get("builder"))
         self.n_devices = self.mesh.shape[PEER_AXIS]
         if n_peers % self.n_devices != 0:
             raise ValueError(
@@ -134,12 +160,66 @@ class ShardedGossipSub:
             )
         self._jitted = {}
 
+    def _make_model(self, builder, peer_uid=None) -> GossipSub:
+        kw = dict(self._gossip_kwargs)
+        kw["builder"] = builder
+        return GossipSub(
+            n_peers=self._n,
+            use_pallas=self._use_pallas,
+            pallas_shard_mesh=self.mesh if self._use_pallas else None,
+            split_gather_mesh=(
+                self.mesh if (self.split_gather and not self._use_pallas)
+                else None
+            ),
+            peer_uid=peer_uid,
+            **kw,
+        )
+
     # -- state placement ----------------------------------------------------
 
     def shardings(self, st: GossipState):
         return gossip_state_shardings(st, self.mesh, self.model.n)
 
+    def _apply_placement(self, seed: int) -> None:
+        """Build the canonical graph host-side, compute the renumbering, and
+        swap in a model pinned to the relabeled topology + ``peer_uid``."""
+        m = self.model
+        base = self._gossip_kwargs.get("builder") or (
+            build_topology if m.n <= 4096 else build_topology_fast
+        )
+        rng = np.random.default_rng(seed)
+        nbrs, rev, valid, outbound = (
+            np.asarray(a) for a in base(rng, m.n, m.k, m.conn_degree)
+        )
+        if self.placement == "bfs":
+            perm, inv = partition_bfs(nbrs, valid, self.n_devices)
+        else:
+            perm, inv = random_placement(m.n, seed=seed)
+        self.perm, self.inv = perm, inv
+        self.placement_report = placement_report(
+            nbrs, valid, self.n_devices, perm, seed=seed
+        )
+        rtopo = relabel_topology(nbrs, rev, valid, outbound, perm)
+        self.model = self._make_model(
+            builder=lambda _rng, _n, _k, _d: rtopo, peer_uid=perm
+        )
+        self._jitted.clear()
+
+    def to_physical(self, canonical_ids):
+        """Canonical peer id(s) -> physical row(s) under the placement."""
+        if self.inv is None:
+            return canonical_ids
+        return np.asarray(self.inv)[np.asarray(canonical_ids)]
+
+    def to_canonical(self, x):
+        """Canonical-order view of a physical per-peer array (leading dim N)."""
+        if self.inv is None:
+            return x
+        return x[np.asarray(self.inv)]
+
     def init(self, seed: int = 0) -> GossipState:
+        if self.placement is not None:
+            self._apply_placement(seed)
         st = self.model.init(seed)
         return jax.device_put(st, self.shardings(st))
 
@@ -165,7 +245,9 @@ class ShardedGossipSub:
             st,
             extra_in=(0, 1, 2),
         )
-        return f(st, src, slot, valid)
+        # ``src`` is a CANONICAL id; under a placement the publisher lives
+        # at physical row inv[src].
+        return f(st, self.to_physical(src), slot, valid)
 
     def step(self, st: GossipState) -> GossipState:
         return self._pin("step", lambda s: self.model.step(s), st)(st)
@@ -180,7 +262,29 @@ class ShardedGossipSub:
         f = self._pin(
             "kill", lambda s, m: self.model.kill_peers(s, m), st, extra_in=(0,)
         )
+        # ``mask`` indexes canonical peers; physical row i is canonical
+        # peer perm[i], so the physical mask is mask[perm].
+        if self.perm is not None:
+            mask = np.asarray(mask)[np.asarray(self.perm)]
         return f(st, mask)
+
+    def rollout(self, st: GossipState, n_steps: int, record: bool = True):
+        """Recorded rollout -> (final state, flight record | None), state
+        shardings pinned.  The flight-record channels are placement-
+        invariant (per-round sums / extrema / histograms over all peers),
+        so no translation is needed on the record."""
+        name = f"rollout{n_steps}_{record}"
+        if name not in self._jitted:
+            sh = self.shardings(st)
+            self._jitted[name] = jax.jit(
+                lambda s: self.model.rollout(s, n_steps, record),
+                in_shardings=(sh,),
+            )
+        out_st, rec = self._jitted[name](st)
+        # Re-pin: GSPMD may hand zero-size leaves (e.g. an empty fresh_hist)
+        # back replicated, which the other pinned entry points then reject.
+        # device_put is a no-op for leaves already on the right sharding.
+        return jax.device_put(out_st, self.shardings(out_st)), rec
 
     def delivery_stats(self, st: GossipState):
         return self.model.delivery_stats(st)

@@ -42,6 +42,17 @@ SCALAR_ROWS: List[Tuple[Tuple[str, ...], str, bool]] = [
     (("ed25519_native_sigs_per_sec",), "native ed25519 sigs/s", True),
     (("treecast_10peer_deliveries_per_sec",), "treecast deliveries/s", True),
     (("scoring_heartbeat_ms",), "scoring heartbeat (ms)", False),
+    # Locality-aware sharded section (r10+); records without it just show
+    # "-" here and a header warning, never a crash.
+    (("sharded", "value"), "sharded msgs/sec", True),
+    (("sharded", "delivery_frac"), "sharded delivery frac", True),
+    (("sharded", "rollout_s"), "sharded rollout (s)", False),
+    (("sharded", "init_s"), "sharded init+placement (s)", False),
+    (("sharded", "compile_s"), "sharded compile (s)", False),
+    (("sharded", "p50_latency_rounds"), "sharded p50 (rounds)", False),
+    (("sharded", "edge_cut", "cut_frac"), "sharded cut frac", False),
+    (("sharded", "edge_cut", "cut_reduction_vs_random"),
+     "sharded cut reduction vs random", True),
 ]
 
 
@@ -123,6 +134,22 @@ def collect_rows(old: Dict[str, Any], new: Dict[str, Any], threshold: float):
         delta, flag = classify(o, n, True, threshold)
         rows.append((f"device ed25519 @{b} (sigs/s)", fmt(o), fmt(n),
                      delta, flag))
+    # sharded per-phase split/monolithic times, lower is better
+    def _sharded_phases(d):
+        s = d.get("sharded")
+        return s.get("phase_split_ms", {}) if isinstance(s, dict) else {}
+
+    sp_old, sp_new = _sharded_phases(old), _sharded_phases(new)
+    for ph in sorted(set(sp_old) | set(sp_new)):
+        keys = sorted(
+            {k for k in (*sp_old.get(ph, {}), *sp_new.get(ph, {}))
+             if k.endswith("_ms")}
+        )
+        for k in keys:
+            o = dig(old, ("sharded", "phase_split_ms", ph, k))
+            n = dig(new, ("sharded", "phase_split_ms", ph, k))
+            delta, flag = classify(o, n, False, threshold)
+            rows.append((f"sharded {ph}.{k}", fmt(o), fmt(n), delta, flag))
     return rows
 
 
@@ -146,6 +173,30 @@ def context_warnings(old: Dict[str, Any], new: Dict[str, Any]) -> List[str]:
             warns.append(
                 f"{key} differs: {old.get(key)!r} vs {new.get(key)!r}"
             )
+    # Sharded section (r10+): presence mismatch or an error payload makes
+    # the sharded rows one-sided — say so instead of crashing or silently
+    # printing dashes.
+    so, sn = old.get("sharded"), new.get("sharded")
+    if (so is None) != (sn is None):
+        which = "old" if so is None else "new"
+        warns.append(
+            f"only one record has a 'sharded' section (missing in {which}; "
+            f"added in r10) — sharded rows are one-sided"
+        )
+    for name, s in (("old", so), ("new", sn)):
+        if isinstance(s, dict) and "error" in s:
+            warns.append(
+                f"{name} sharded section is an error record: "
+                f"{str(s['error'])[:200]}"
+            )
+    if (isinstance(so, dict) and isinstance(sn, dict)
+            and "error" not in so and "error" not in sn):
+        for key in ("backend", "n_peers", "n_devices"):
+            if so.get(key) != sn.get(key):
+                warns.append(
+                    f"sharded {key} differs: {so.get(key)!r} vs "
+                    f"{sn.get(key)!r}"
+                )
     return warns
 
 
