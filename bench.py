@@ -79,6 +79,18 @@ SHARDED_SCALE = dict(n_peers=102_400, n_devices=8, n_slots=32, degree=16,
                      steps=48, topo_seed=0, reps=2)
 SHARDED_RUN_TIMEOUT_S = 1500.0
 
+# Coded-gossip head-to-head (BENCH_MODE=rlnc): RLNC vs the eager+IWANT
+# pipeline on the SAME fixed-seed topology (identical n/k/degree/seed ->
+# identical graph; see RLNC.build_graph), under a clean fabric and a
+# degraded-link window (same cohort for both models — ingress DECIMATION
+# for rlnc, ingress hold for gossipsub; the semantic gap is reported, not
+# hidden).  The coded plane is pure table-lookup GF(256) on CPU, so the
+# scale is modest; the JSON reports what actually ran.
+RLNC_SCALE = dict(n_peers=1024, n_slots=16, degree=8, gen_size=8,
+                  steps=24, topo_seed=0, degraded_frac=0.25,
+                  degraded_delay=2)
+RLNC_RUN_TIMEOUT_S = 900.0
+
 PROBE_TIMEOUT_S = 180.0
 # The r3 TPU run took ~4.5 min, and the r5 child adds the device-kernel
 # scaling curve (4 compiled batch shapes) and the phase-breakdown compiles,
@@ -201,6 +213,26 @@ def _run_sharded_child(probe_ok: bool) -> dict:
     return {"error": " | ".join(a[:300] for a in attempts)}
 
 
+def _run_rlnc_child(probe_ok: bool) -> dict:
+    """Run the BENCH_MODE=rlnc child (coded gossip vs eager+IWANT on one
+    topology).  Accelerator first when the probe passed, CPU fallback
+    otherwise; failure becomes an ``error`` dict, never a crash."""
+    attempts = []
+    if probe_ok:
+        parsed, tail = run_child({"BENCH_MODE": "rlnc"}, RLNC_RUN_TIMEOUT_S)
+        if parsed is not None:
+            return parsed
+        attempts.append(f"accelerator attempt: {tail}")
+        log("orchestrator: rlnc accelerator child failed; retrying on CPU")
+    parsed, tail = run_child(
+        {"BENCH_MODE": "rlnc", "JAX_PLATFORMS": "cpu"}, RLNC_RUN_TIMEOUT_S
+    )
+    if parsed is not None:
+        return parsed
+    attempts.append(f"cpu attempt: {tail}")
+    return {"error": " | ".join(a[:300] for a in attempts)}
+
+
 def orchestrate() -> None:
     attempts = []
     record = None
@@ -244,6 +276,12 @@ def orchestrate() -> None:
     if os.environ.get("BENCH_SHARDED", "1") != "0":
         log("orchestrator: running sharded child (BENCH_MODE=sharded)")
         record["sharded"] = _run_sharded_child(probe_ok)
+
+    # Coded-gossip head-to-head rides along the same way
+    # (tools/perf_diff.py diffs it; BENCH_RLNC=0 skips it).
+    if os.environ.get("BENCH_RLNC", "1") != "0":
+        log("orchestrator: running rlnc child (BENCH_MODE=rlnc)")
+        record["rlnc"] = _run_rlnc_child(probe_ok)
 
     print(json.dumps(record))
 
@@ -677,10 +715,165 @@ def sharded_child_main() -> None:
     )
 
 
+def rlnc_child_main() -> None:
+    """BENCH_MODE=rlnc: coded gossip vs eager+IWANT, head to head (ISSUE 6
+    tentpole).  Four measured rollouts — {RLNC, GossipSub} x {clean,
+    degraded links} — all on the IDENTICAL fixed-seed topology, fed the
+    same real signed window with native-backend verdicts gating relay.
+    Emits one JSON line the orchestrator nests under ``rlnc``."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from go_libp2p_pubsub_tpu.models.gossipsub import GossipSub
+    from go_libp2p_pubsub_tpu.models.rlnc import RLNC
+
+    cfg = RLNC_SCALE
+    n_peers = int(os.environ.get("BENCH_RLNC_PEERS", cfg["n_peers"]))
+    steps = int(os.environ.get("BENCH_RLNC_STEPS", cfg["steps"]))
+    dev = jax.devices()[0]
+    backend = dev.device_kind
+    log(f"rlnc bench: {backend}  n_peers={n_peers}  steps={steps}  "
+        f"gen_size={cfg['gen_size']}")
+    rng = np.random.default_rng(1)
+
+    # Same closed loop as the headline: real signed window, native verify,
+    # verdicts gate relay in BOTH models.
+    envs, forged_idx = make_signed_window(rng)
+    expected = np.array([i not in forged_idx for i in range(N_MSGS)])
+    verdicts, verify_dt, _ = native_verify_window(envs, rng)
+    assert bool(np.all(verdicts == expected)), "native verdicts wrong"
+    log(f"signed window verified (charged {verify_dt*1e3:.2f} ms)")
+
+    # One publisher draw, reused by every run: the comparison differs only
+    # in the propagation model (and the degraded cohort, shared too).
+    srcs = rng.integers(n_peers, size=N_MSGS)
+    cohort = rng.choice(
+        n_peers, size=max(1, round(cfg["degraded_frac"] * n_peers)),
+        replace=False,
+    )
+    delay = np.zeros(n_peers, np.int32)
+    delay[cohort] = cfg["degraded_delay"]
+
+    rl = RLNC(n_peers=n_peers, n_slots=cfg["n_slots"],
+              conn_degree=cfg["degree"], msg_window=N_MSGS,
+              gen_size=cfg["gen_size"])
+    gs = GossipSub(n_peers=n_peers, n_slots=cfg["n_slots"],
+                   conn_degree=cfg["degree"], msg_window=N_MSGS,
+                   use_pallas=False)
+    # The degraded eager pipeline must pay on the EAGER path too, not just
+    # the IHAVE/IWANT pend plane — a gossip_delay-only window leaves mesh
+    # push untouched and the comparison would flatter nobody honestly.
+    # max_edge_delay > 0 carries the fresh-history planes, so it is a
+    # separate model (same seed -> same graph).
+    gs_deg = GossipSub(n_peers=n_peers, n_slots=cfg["n_slots"],
+                       conn_degree=cfg["degree"], msg_window=N_MSGS,
+                       use_pallas=False,
+                       max_edge_delay=cfg["degraded_delay"])
+    assert bool(jnp.array_equal(rl.build_graph(cfg["topo_seed"])[0],
+                                gs.build_graph(cfg["topo_seed"])[0])), \
+        "head-to-head topologies diverged"
+
+    edge_delay = np.zeros((n_peers, cfg["n_slots"]), np.int32)
+    edge_delay[cohort, :] = cfg["degraded_delay"]  # cohort ingress edges
+
+    def degrade(model, st):
+        st = model.set_gossip_delay(st, jnp.asarray(delay))
+        if isinstance(model, GossipSub):
+            st = model.set_edge_delay(st, edge_delay)
+        return st
+
+    def measure(model, name, degraded):
+        st = model.init(seed=cfg["topo_seed"])
+        if degraded:
+            st = degrade(model, st)
+        for slot in range(N_MSGS):
+            st = model.publish(
+                st, jnp.int32(int(srcs[slot])), jnp.int32(slot),
+                jnp.asarray(bool(verdicts[slot])),
+            )
+        t0 = time.perf_counter()
+        jax.block_until_ready(model.rollout(st, steps, record=True))
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out, rec = model.rollout(st, steps, record=True)
+        jax.block_until_ready((out, rec))
+        rollout_dt = time.perf_counter() - t0
+        frac, p50, p99 = (np.asarray(x) for x in model.delivery_stats(out))
+        # Forged non-propagation under the REAL verdicts.
+        if isinstance(model, RLNC):
+            rank = np.asarray(model.rank(out))
+            for i in forged_idx:
+                assert int((rank[:, i] > 0).sum()) <= 1, \
+                    f"forged generation {i} propagated ({name})"
+        else:
+            have = np.asarray(model.have_bool(out))
+            for i in forged_idx:
+                assert int(have[:, i].sum()) <= 1, \
+                    f"forged msg {i} propagated ({name})"
+        mean_frac = float(np.nanmean(frac))
+        delivered = float(np.nansum(frac)) * n_peers
+        value = delivered / (rollout_dt + verify_dt)
+        log(f"{name}: {value:,.0f} msgs/s  frac {mean_frac:.4f}  "
+            f"p50 {float(p50):.0f} p99 {float(p99):.0f} rounds  "
+            f"(rollout {rollout_dt:.2f}s, compile {compile_s:.1f}s)")
+        return {
+            "msgs_per_sec": round(value, 1),
+            "p50_latency_rounds": float(p50),
+            "p99_latency_rounds": float(p99),
+            "delivery_frac": round(mean_frac, 6),
+            "rollout_s": round(rollout_dt, 3),
+            "compile_s": round(compile_s, 1),
+        }
+
+    sections = {
+        "clean": {
+            "rlnc": measure(rl, "rlnc/clean", False),
+            "eager_iwant": measure(gs, "eager_iwant/clean", False),
+        },
+        "degraded": {
+            "rlnc": measure(rl, "rlnc/degraded", True),
+            "eager_iwant": measure(gs_deg, "eager_iwant/degraded", True),
+        },
+    }
+
+    print(
+        json.dumps(
+            {
+                "metric": "rlnc_validated_msgs_per_sec",
+                "value": sections["clean"]["rlnc"]["msgs_per_sec"],
+                "unit": "msgs/sec",
+                "methodology_version": 2,
+                "n_peers": n_peers,
+                "gen_size": cfg["gen_size"],
+                "rollout_steps": steps,
+                "backend": backend,
+                "topo_seed": cfg["topo_seed"],
+                "degraded_frac": cfg["degraded_frac"],
+                "degraded_delay": cfg["degraded_delay"],
+                "degraded_semantics": (
+                    "rlnc: ingress decimation (off-gate fragments LOST); "
+                    "eager_iwant: per-edge eager hold (max_edge_delay) + "
+                    "gossip pend hold (late, lossless)"
+                ),
+                "window_verify_charged_ms": round(verify_dt * 1e3, 2),
+                "clean": sections["clean"],
+                "degraded": sections["degraded"],
+            }
+        ),
+        flush=True,
+    )
+
+
 def child_main() -> None:
     mode = os.environ.get("BENCH_MODE", "tpu")
     if mode == "sharded":
         return sharded_child_main()
+    if mode == "rlnc":
+        return rlnc_child_main()
     scale = TPU_SCALE if mode == "tpu" else CPU_SCALE
 
     import jax

@@ -1,0 +1,573 @@
+"""RLNC — coded gossip: random linear network coding as a first-class model.
+
+The third propagation model beside GossipSub/TreeCast (ROADMAP item 5,
+OPTIMUMP2P arxiv 2508.04833).  Where the mesh families move whole messages
+(eager push + the IHAVE/IWANT round trip), every RLNC relay forwards a
+fresh random GF(256) combination of whatever it already holds for a
+*generation* (one published message = ``gen_size`` source fragments), and
+a receiver "delivers" the moment its decode basis reaches full rank — from
+ANY ``gen_size`` independent fragments, no matter which relays they came
+through.  There is no two-phase recovery path at all: redundancy is
+algebraic, so lossy links cost extra coded rounds instead of
+IHAVE -> IWANT -> transfer round trips.
+
+State is one structured elimination basis per (peer, generation)
+(``ops.gf256.rref_insert``; u8[N, G, Kg, Kg]) plus the same topology /
+liveness / message-window planes as GossipSub, so the model plugs into the
+existing surfaces unchanged:
+
+- ``rollout(record=True)`` emits the SAME flight-recorder channels
+  (delivery frac, latency histogram via ``ops/histogram.py``, backlog —
+  now measured in held FRAGMENTS of undecoded generations);
+- ``rollout_events`` consumes ``ops.schedule.GossipEvents`` tensors, so
+  the scenario compiler's churn / link-delay / workload lowering applies
+  as-is and ``scenario.slo.evaluate`` grades verdicts from the record;
+- ``delivery_stats`` reads the same ``first_step`` receipt table.
+
+Semantics mapping (documented deviations from the mesh families):
+
+- there is no mesh: every live edge relays every round, and the
+  ``mesh_degree_*`` record channels report live-edge degree;
+- no scoring plane: ``score_p10/50/90`` are recorded as 0.0 (the SLO
+  canon never grades them for this family);
+- ``gossip_delay`` d models a DEGRADED link as ingress decimation: the
+  peer accepts incoming fragments only every (d+1)-th round and fragments
+  sent in between are LOST.  The mesh families instead *hold* pending
+  transfers (lossless, late).  Decimation is the honest lossy-link analog
+  for a rateless code — exactly the regime where coding is predicted to
+  win — but it means identical ``LinkWindow`` specs are a *harsher*
+  fabric here than for GossipSub (PERF.md r11 honesty notes);
+- ``gossip_mute`` peers hold receive-only (no coded emissions) — the
+  nearest analog of the promise-breaking adversary;
+- event ``silence`` suppresses a peer's emissions for the FOLLOWING round
+  (the mesh families squelch the just-received fresh plane post-step);
+  the compiler rejects attack waves for this family, so canon scenarios
+  never exercise it.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import gf256
+from ..ops import histogram as hist_ops
+from .gossipsub import (
+    FLIGHT_HIST_BINS,
+    build_topology,
+    build_topology_fast,
+    compute_edge_live,
+)
+
+
+class RLNCState(NamedTuple):
+    """Coded-gossip state: N peers, K neighbor slots, G generations in the
+    message window, Kg = ``gen_size`` source fragments per generation."""
+
+    nbrs: jax.Array        # i32[N, K] connection slots -> remote peer id
+    rev: jax.Array         # i32[N, K] remote's slot index back to me
+    nbr_valid: jax.Array   # bool[N, K]
+    alive: jax.Array       # bool[N]
+    subscribed: jax.Array  # bool[N] topic membership
+    edge_live: jax.Array   # bool[N, K] nbr_valid & alive[nbrs] (cached)
+    basis: jax.Array       # u8[N, G, Kg, Kg] structured decode basis per
+    #                        (peer, generation) — pivot-slot form, rank on
+    #                        the diagonal (ops.gf256.rref_insert)
+    first_step: jax.Array  # i32[N, G] decode-complete (full rank) stamp;
+    #                        -1 = never.  The delivery-receipt table every
+    #                        recorder/stat surface reads.
+    msg_valid: jax.Array   # bool[G] validation verdict per generation
+    msg_birth: jax.Array   # i32[G] publish step
+    msg_active: jax.Array  # bool[G] generation still being relayed
+    msg_used: jax.Array    # bool[G] ever published (until slot reuse)
+    gossip_mute: jax.Array   # bool[N] receive-only peers (no emissions)
+    gossip_delay: jax.Array  # i32[N] degraded-ingress decimation: accept
+    #                          incoming fragments only when
+    #                          step % (delay + 1) == 0; 0 = ideal fabric
+    silenced: jax.Array      # bool[N] emissions suppressed this round
+    #                          (event plane; always False outside campaigns)
+    key: jax.Array           # PRNG key (coefficient substreams)
+    step: jax.Array          # i32
+
+
+class RLNC:
+    """Single-topic coded-gossip simulator with static shapes."""
+
+    def __init__(
+        self,
+        n_peers: int = 1024,
+        n_slots: int = 32,
+        conn_degree: int = 16,
+        msg_window: int = 64,
+        gen_size: int = 8,
+        builder=None,
+        peer_uid: Optional[np.ndarray] = None,
+    ):
+        if gen_size < 1:
+            raise ValueError("gen_size must be >= 1")
+        if gen_size > 255:
+            raise ValueError("gen_size must be <= 255 (GF(256) coefficients)")
+        self.n = n_peers
+        self.k = n_slots
+        self.m = msg_window       # generations in flight (the window)
+        self.gen_size = gen_size  # Kg source fragments per generation
+        self.conn_degree = conn_degree
+        self.builder = builder    # explicit topology builder (seed pinning)
+        if peer_uid is None:
+            self.peer_uid = None
+        else:
+            pu = np.asarray(peer_uid)
+            if pu.shape != (n_peers,):
+                raise ValueError(f"peer_uid must be [N={n_peers}]")
+            if not np.array_equal(np.sort(pu), np.arange(n_peers)):
+                raise ValueError("peer_uid must be a permutation of 0..N-1")
+            self.peer_uid = jnp.asarray(pu, jnp.int32)
+
+    # Value semantics for the jit cache (the GossipSub convention): the
+    # model is a pure function of its configuration.
+    def _config_key(self):
+        if self.builder is not None:
+            return id(self)
+        return (
+            type(self), self.n, self.k, self.m, self.gen_size,
+            self.conn_degree,
+            None if self.peer_uid is None
+            else bytes(np.asarray(self.peer_uid)),
+        )
+
+    def __eq__(self, other):
+        return (
+            type(other) is type(self)
+            and self._config_key() == other._config_key()
+        )
+
+    def __hash__(self):
+        return hash(self._config_key())
+
+    def build_graph(self, seed: int = 0):
+        """Connection topology -> (nbrs, rev, nbr_valid) as jnp arrays.
+
+        Same builder dispatch (and same rng draw order) as
+        ``GossipSub.build_graph``, so an RLNC model constructed with the
+        same (n, k, degree, seed) runs on the IDENTICAL fixed-seed graph —
+        the head-to-head bench's apples-to-apples topology guarantee.
+        """
+        rng = np.random.default_rng(seed)
+        builder = self.builder or (
+            build_topology if self.n <= 4096 else build_topology_fast
+        )
+        nbrs, rev, valid, _outbound = builder(
+            rng, self.n, self.k, self.conn_degree
+        )
+        return (
+            jnp.asarray(nbrs, jnp.int32),
+            jnp.asarray(rev, jnp.int32),
+            jnp.asarray(valid),
+        )
+
+    def init(
+        self, seed: int = 0, subscribed: Optional[np.ndarray] = None
+    ) -> RLNCState:
+        """Fresh state; no warmup needed (there is no mesh to converge)."""
+        nbrs, rev, valid = self.build_graph(seed)
+        n, m, kg = self.n, self.m, self.gen_size
+        alive0 = jnp.ones((n,), bool)
+        sub0 = (
+            jnp.ones((n,), bool) if subscribed is None
+            else jnp.asarray(subscribed)
+        )
+        return RLNCState(
+            nbrs=nbrs,
+            rev=rev,
+            nbr_valid=valid,
+            alive=alive0,
+            subscribed=sub0,
+            edge_live=compute_edge_live(valid, nbrs, alive0),
+            basis=jnp.zeros((n, m, kg, kg), jnp.uint8),
+            first_step=jnp.full((n, m), -1, jnp.int32),
+            msg_valid=jnp.zeros((m,), bool),
+            msg_birth=jnp.zeros((m,), jnp.int32),
+            msg_active=jnp.zeros((m,), bool),
+            msg_used=jnp.zeros((m,), bool),
+            gossip_mute=jnp.zeros((n,), bool),
+            gossip_delay=jnp.zeros((n,), jnp.int32),
+            silenced=jnp.zeros((n,), bool),
+            key=jax.random.PRNGKey(seed),
+            step=jnp.asarray(0, jnp.int32),
+        )
+
+    # -- views ---------------------------------------------------------------
+
+    def rank(self, st: RLNCState) -> jax.Array:
+        """i32[N, G] decode rank per (peer, generation)."""
+        return gf256.gf_rank(st.basis)
+
+    # -- events --------------------------------------------------------------
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def publish(
+        self,
+        st: RLNCState,
+        src: jax.Array,
+        slot: jax.Array,
+        valid: jax.Array,
+    ) -> RLNCState:
+        """Seed a generation at ``src`` in window ``slot`` (recycling it).
+
+        The publisher holds the source fragments, i.e. the identity basis
+        (full rank), and stamps its own receipt at latency zero — matching
+        ``GossipSub.publish``'s self-stamp.  All other peers' bases for the
+        recycled slot are cleared (a stale basis would decode the OLD
+        generation into a phantom receipt of the new one — the coded twin
+        of ``seed_message``'s pend-plane scrub).
+
+        A generation whose envelope FAILED validation never enters relay
+        (``msg_active`` stays False, so ``can_send`` masks it) — the coded
+        analog of the mesh sim's verdict-gated forwarding: you cannot
+        validate a fragment, only a decoded message, so a publisher-known
+        forged generation is refused at the source and the bench asserts
+        zero propagation.
+        """
+        kg = self.gen_size
+        eye = jnp.eye(kg, dtype=jnp.uint8)
+        basis = (
+            st.basis.at[:, slot].set(jnp.zeros((kg, kg), jnp.uint8))
+            .at[src, slot].set(eye)
+        )
+        return st._replace(
+            basis=basis,
+            first_step=st.first_step.at[:, slot].set(-1)
+            .at[src, slot].set(st.step),
+            msg_valid=st.msg_valid.at[slot].set(valid),
+            msg_birth=st.msg_birth.at[slot].set(st.step),
+            msg_active=st.msg_active.at[slot].set(valid),
+            msg_used=st.msg_used.at[slot].set(True),
+        )
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def kill_peers(self, st: RLNCState, mask: jax.Array) -> RLNCState:
+        alive = st.alive & ~mask
+        return st._replace(
+            alive=alive,
+            edge_live=compute_edge_live(st.nbr_valid, st.nbrs, alive),
+        )
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def set_gossip_delay(self, st: RLNCState, delay: jax.Array) -> RLNCState:
+        """Install per-peer ingress decimation (see module docstring: a
+        delay-d peer accepts fragments every (d+1)-th round, others LOST)."""
+        return st._replace(gossip_delay=delay.astype(jnp.int32))
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def set_gossip_mute(self, st: RLNCState, mask: jax.Array) -> RLNCState:
+        """Mark peers (bool[N]) receive-only: they decode but never emit."""
+        return st._replace(gossip_mute=mask)
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def set_subscribed(self, st: RLNCState, sub: jax.Array) -> RLNCState:
+        """Change topic membership; non-members neither emit nor accept."""
+        return st._replace(subscribed=sub)
+
+    # -- transition ----------------------------------------------------------
+
+    def _step_core(self, st: RLNCState) -> Tuple[RLNCState, jax.Array]:
+        """One coded round -> (new state, per-generation new-receipt counts).
+
+        1. every eligible holder draws ONE random coefficient row per
+           (out-slot, generation) and emits the coded combination of its
+           basis rows over each live edge (``gf_combine`` — the batched
+           byte-matmul encode);
+        2. receivers gather their in-edge fragments (sender j's slot
+           ``rev[i, s]`` fragment), mask ineligible ones to the zero
+           vector, and fold them through the vectorized elimination kernel
+           (``rref_insert`` vmapped over [N, G], one in-slot at a time);
+        3. a basis reaching full rank stamps ``first_step`` — the delivery
+           receipt the flight recorder and SLO plane consume.
+        """
+        n, k, g, kg = self.n, self.k, self.m, self.gen_size
+        key_c, key_n = jax.random.split(st.key)
+
+        rank = gf256.gf_rank(st.basis)                     # i32[N, G]
+        # Sender eligibility per (peer, gen): holds something, is a live
+        # participant, and the generation is still in relay.
+        can_send = (
+            (rank > 0)
+            & (st.alive & st.subscribed & ~st.gossip_mute
+               & ~st.silenced)[:, None]
+            & (st.msg_active & st.msg_used)[None, :]
+        )                                                   # bool[N, G]
+
+        # Per-edge coded fragments: coefficient rows keyed on canonical
+        # identity (placement-proof, like every mesh-plane draw), one row
+        # per (sender, out-slot, generation).
+        coeffs = gf256.coeffs_by_uid(
+            key_c, (n, k, g, kg), self.peer_uid
+        )                                                   # u8[N, K, G, Kg]
+        frag_out = gf256.gf_combine(
+            coeffs, st.basis[:, None]
+        )                                                   # u8[N, K, G, Kg]
+
+        # Receiver gather: in-slot s of peer i carries sender j = nbrs[i,s]
+        # and j's fragment for THIS edge sits at j's out-slot rev[i,s].
+        j = jnp.clip(st.nbrs, 0, n - 1)
+        flat_idx = j * k + jnp.clip(st.rev, 0, k - 1)       # i32[N, K]
+        incoming = frag_out.reshape(n * k, g, kg)[flat_idx]  # u8[N, K, G, Kg]
+        sender_ok = can_send[j]                              # bool[N, K, G]
+
+        # Ingress gate: decimated peers accept only every (delay+1)-th
+        # round; everyone else every round.  Fragments outside the gate are
+        # zeroed — a zero vector is a no-op insert, so masking IS dropping.
+        accept = (
+            st.alive & st.subscribed
+            & (jnp.mod(st.step, st.gossip_delay + 1) == 0)
+        )                                                   # bool[N]
+        ok = sender_ok & (st.edge_live & accept[:, None])[:, :, None]
+        incoming = jnp.where(ok[..., None], incoming, jnp.uint8(0))
+
+        insert = jax.vmap(jax.vmap(gf256.rref_insert))      # over [N, G]
+
+        def fold(s, basis):
+            return insert(basis, incoming[:, s])[0]
+
+        basis = jax.lax.fori_loop(0, k, fold, st.basis)
+
+        # Delivery receipts: bases that JUST reached full rank.
+        done_new = (
+            (gf256.gf_rank(basis) == kg) & (st.first_step < 0)
+        )                                                   # bool[N, G]
+        first_step = jnp.where(done_new, st.step, st.first_step)
+        per_msg = done_new.sum(axis=0, dtype=jnp.int32)     # i32[G]
+        return (
+            st._replace(
+                basis=basis, first_step=first_step, key=key_n,
+                step=st.step + 1,
+            ),
+            per_msg,
+        )
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def step(self, st: RLNCState) -> RLNCState:
+        return self._step_core(st)[0]
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def step_recorded(self, st: RLNCState):
+        """(state, per-generation new-receipt counts i32[G]) — the latency
+        histogram's per-round increment source, like GossipSub's."""
+        return self._step_core(st)
+
+    def run(self, st: RLNCState, n_steps: int) -> RLNCState:
+        return self.rollout(st, n_steps, record=False)[0]
+
+    @functools.partial(jax.jit, static_argnames=("self", "n_steps", "record"))
+    def rollout(self, st: RLNCState, n_steps: int, record: bool = True):
+        """``n_steps`` coded rounds -> (final state, flight record | None).
+
+        Identical recorder architecture to ``GossipSub.rollout``: the
+        cumulative latency histogram rides the scan carry, seeded from the
+        stamp table (``latency_histogram_seed``'s scalar fast path covers
+        the fresh-publish case) and advanced per round from the receipts
+        stamped that round.  ``first_step``/``msg_birth`` have the same
+        [N, G]/[G] shape contract the mesh families use, so the histogram
+        ops apply unchanged.
+        """
+        if not record:
+            def bare(s, _):
+                return self.step(s), None
+
+            return jax.lax.scan(bare, st, None, length=n_steps)
+
+        hist0 = hist_ops.latency_histogram_seed(
+            st.first_step, st.msg_birth, st.msg_used & st.msg_valid,
+            st.alive & st.subscribed, FLIGHT_HIST_BINS,
+        )
+
+        def body(carry, _):
+            s, hist = carry
+            s2, per_msg = self._step_core(s)
+            hist = hist + hist_ops.latency_histogram_increment(
+                per_msg, s2.msg_birth, s2.msg_used & s2.msg_valid,
+                s.step, FLIGHT_HIST_BINS,
+            )
+            return (s2, hist), self.flight_record_round(s2, hist)
+
+        (final, _), record_ys = jax.lax.scan(
+            body, (st, hist0), None, length=n_steps
+        )
+        return final, record_ys
+
+    # -- scenario engine -----------------------------------------------------
+
+    def _apply_events(self, st: RLNCState, ev) -> RLNCState:
+        """Apply one step's ``GossipEvents`` slice (same application order
+        as ``GossipSub._apply_events``; every branch ``lax.cond``-gated).
+
+        ``silence`` is folded here as next-round emission suppression (set
+        before the step, cleared by the next event row) — see the module
+        docstring for the timing deviation vs the mesh families.
+        """
+
+        def upd_alive(s):
+            alive = (s.alive & ~ev.kill) | ev.revive
+            return s._replace(
+                alive=alive,
+                edge_live=compute_edge_live(s.nbr_valid, s.nbrs, alive),
+            )
+
+        st = jax.lax.cond(
+            ev.kill.any() | ev.revive.any(), upd_alive, lambda s: s, st
+        )
+        st = jax.lax.cond(
+            ev.sub_off.any() | ev.sub_on.any(),
+            lambda s: s._replace(
+                subscribed=(s.subscribed & ~ev.sub_off) | ev.sub_on
+            ),
+            lambda s: s,
+            st,
+        )
+        st = jax.lax.cond(
+            ev.mute_on.any() | ev.mute_off.any(),
+            lambda s: s._replace(
+                gossip_mute=(s.gossip_mute & ~ev.mute_off) | ev.mute_on
+            ),
+            lambda s: s,
+            st,
+        )
+        st = jax.lax.cond(
+            (ev.delay >= 0).any(),
+            lambda s: s._replace(
+                gossip_delay=jnp.where(
+                    ev.delay >= 0, ev.delay, s.gossip_delay
+                )
+            ),
+            lambda s: s,
+            st,
+        )
+        st = st._replace(silenced=ev.silence)
+        for i in range(ev.pub_src.shape[0]):
+            st = jax.lax.cond(
+                ev.pub_src[i] >= 0,
+                lambda s, j=i: self.publish(
+                    s,
+                    ev.pub_src[j],
+                    jnp.clip(ev.pub_slot[j], 0, self.m - 1),
+                    ev.pub_valid[j],
+                ),
+                lambda s: s,
+                st,
+            )
+        return st
+
+    @functools.partial(jax.jit, static_argnames=("self", "record"))
+    def rollout_events(self, st: RLNCState, events, record: bool = True):
+        """Run a whole ``GossipEvents`` schedule in ONE ``lax.scan`` ->
+        (final state, flight record | None) — the scenario runner's
+        entry point, signature-compatible with the non-gossipsub dispatch
+        in ``scenario.runner._run_compiled``.
+
+        Publisher self-receipts of in-scan publishes fold into the
+        histogram at bin 0 exactly as in ``GossipSub.rollout_events``, so
+        ``delivery_frac`` stays exact for slot-unique campaigns.
+        """
+        n_steps = int(events.kill.shape[0])
+
+        if not record:
+            def bare(s, ev):
+                s = self._apply_events(s, ev)
+                return self.step(s), None
+
+            return jax.lax.scan(bare, st, events, length=n_steps)
+
+        hist0 = hist_ops.latency_histogram_seed(
+            st.first_step, st.msg_birth, st.msg_used & st.msg_valid,
+            st.alive & st.subscribed, FLIGHT_HIST_BINS,
+        )
+
+        def body(carry, ev):
+            s, hist = carry
+            s = self._apply_events(s, ev)
+            src_c = jnp.clip(ev.pub_src, 0, self.n - 1)
+            pub_counted = (
+                (ev.pub_src >= 0)
+                & ev.pub_valid
+                & s.alive[src_c]
+                & s.subscribed[src_c]
+            ).sum(dtype=jnp.int32)
+            hist = hist.at[0].add(pub_counted)
+            s2, per_msg = self._step_core(s)
+            hist = hist + hist_ops.latency_histogram_increment(
+                per_msg, s2.msg_birth, s2.msg_used & s2.msg_valid,
+                s.step, FLIGHT_HIST_BINS,
+            )
+            return (s2, hist), self.flight_record_round(s2, hist)
+
+        (final, _), record_ys = jax.lax.scan(
+            body, (st, hist0), events, length=n_steps
+        )
+        return final, record_ys
+
+    # -- flight recorder -----------------------------------------------------
+
+    def flight_record_round(self, st: RLNCState, lat_hist: jax.Array):
+        """One round's telemetry — the SAME channel names/dtypes as
+        ``GossipSub.flight_record_round`` so ``scenario.slo.evaluate``,
+        ``utils.metrics.flight_summary`` and the trace replay surface work
+        unchanged.  ``mesh_degree_*`` report live-edge degree (there is no
+        mesh); ``score_p*`` are 0.0 (no scoring plane); ``gossip_pending``
+        is the decode BACKLOG in fragments: basis rows held for
+        generations that have not yet reached full rank.
+        """
+        part = st.alive & st.subscribed
+        part_n = jnp.maximum(part.sum(), 1)
+        in_window = st.msg_used & st.msg_valid
+        n_msgs = jnp.maximum(in_window.sum(), 1)
+        deg = st.edge_live.sum(axis=1)
+        deg_alive = jnp.where(part, deg, 0)
+        rank = gf256.gf_rank(st.basis)                      # i32[N, G]
+        backlog = jnp.where(
+            (rank < self.gen_size) & st.msg_active[None, :], rank, 0
+        ).sum()
+        zero = jnp.asarray(0.0, jnp.float32)
+        return {
+            "step": st.step,
+            "peers_alive": st.alive.sum(),
+            "delivery_frac": lat_hist.sum() / (part_n * n_msgs),
+            "mesh_degree_mean": deg_alive.sum() / part_n,
+            "mesh_degree_max": deg.max(),
+            "score_p10": zero,
+            "score_p50": zero,
+            "score_p90": zero,
+            "gossip_pending": backlog,
+            "lat_hist": lat_hist,
+        }
+
+    # -- metrics -------------------------------------------------------------
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def delivery_stats(self, st: RLNCState):
+        """Per-generation delivery fraction and decode-latency percentiles
+        (rounds) — same receipt-table arithmetic as GossipSub's."""
+        part = st.alive & st.subscribed
+        part_n = part.sum()
+        delivered = ((st.first_step >= 0) & part[:, None]).sum(axis=0)
+        frac = jnp.where(
+            st.msg_used & st.msg_valid,
+            delivered / jnp.maximum(part_n, 1),
+            jnp.nan,
+        )
+        lat = jnp.where(
+            st.first_step >= 0, st.first_step - st.msg_birth[None, :], -1
+        )
+        valid_lat = (
+            (lat >= 0)
+            & st.msg_used[None, :]
+            & st.msg_valid[None, :]
+            & part[:, None]
+        )
+        lat_f = jnp.where(valid_lat, lat.astype(jnp.float32), jnp.nan)
+        p50 = jnp.nanmedian(lat_f)
+        p99 = jnp.nanpercentile(lat_f, 99.0)
+        return frac, p50, p99

@@ -1,0 +1,208 @@
+"""GF(256) field arithmetic — the coded-gossip (RLNC) byte-matrix plane.
+
+OPTIMUMP2P (PAPERS.md, arxiv 2508.04833) replaces store-and-forward gossip
+with random linear network coding: a message is a *generation* of K source
+fragments, relays forward random GF(256) combinations of whatever they
+hold, and a receiver decodes the moment it has ANY K linearly independent
+combinations.  Everything a relay or receiver does is therefore linear
+algebra over bytes — coefficient-row times basis-matrix products on encode
+(``gf_combine``/``gf_matmul``) and Gaussian elimination on decode
+(``rref_insert``/``gf_solve``) — which is the one workload in this repo
+that is natively matmul-shaped (ROADMAP item 5), unlike the int32 VPU
+crypto.
+
+Representation: the field is GF(2^8) with the AES reduction polynomial
+``x^8 + x^4 + x^3 + x + 1`` (0x11B) and generator 0x03.  Addition is XOR;
+multiplication goes through log/antilog tables (``exp[log[a] + log[b]]``,
+the antilog table doubled to 510 entries so the hot path needs no mod-255)
+— on device that is two integer gathers and a table lookup per product,
+with the zero cases masked (log(0) is undefined; anything times 0 is 0).
+
+Honesty note (PERF.md r11): this is the *table-lookup* formulation — XLA
+lowers the products to gathers on the VPU, not MXU int8 matmuls.  The true
+MXU decomposition (carry-less 8x8-bit products via int8 dot-products plus
+a polynomial-reduction pass) is future work; the shapes here are already
+matmul-shaped so only the inner product kernel would change.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_POLY = 0x11B  # AES reduction polynomial
+_GEN = 0x03    # multiplicative generator
+
+
+def _build_tables() -> Tuple[np.ndarray, np.ndarray]:
+    exp = np.zeros(510, np.int32)
+    log = np.zeros(256, np.int32)
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        # x *= 0x03  ==  xtime(x) ^ x, reduced mod _POLY.
+        x2 = (x << 1) ^ (_POLY if x & 0x80 else 0)
+        x = x2 ^ x
+    exp[255:510] = exp[0:255]  # doubled: exp[log a + log b] needs no mod
+    return exp, log
+
+
+# Host-side module constants; jnp.asarray inside the kernels constant-folds
+# them into the compiled programs.
+GF_EXP, GF_LOG = _build_tables()
+
+
+def gf_mul(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Elementwise GF(256) product (uint8, numpy broadcasting)."""
+    a32 = a.astype(jnp.int32)
+    b32 = b.astype(jnp.int32)
+    exp = jnp.asarray(GF_EXP)
+    log = jnp.asarray(GF_LOG)
+    prod = exp[log[a32] + log[b32]]
+    return jnp.where((a32 == 0) | (b32 == 0), 0, prod).astype(jnp.uint8)
+
+
+def gf_inv(a: jax.Array) -> jax.Array:
+    """Elementwise multiplicative inverse; maps 0 -> 0 (no inverse exists —
+    callers must mask the zero case, as ``rref_insert``/``gf_solve`` do)."""
+    a32 = a.astype(jnp.int32)
+    exp = jnp.asarray(GF_EXP)
+    log = jnp.asarray(GF_LOG)
+    return jnp.where(a32 == 0, 0, exp[255 - log[a32]]).astype(jnp.uint8)
+
+
+def gf_combine(coeffs: jax.Array, rows: jax.Array) -> jax.Array:
+    """Coefficient combination ``XOR_k coeffs[..., k] * rows[..., k, :]``.
+
+    ``coeffs`` u8[..., K], ``rows`` u8[..., K, L] -> u8[..., L], with numpy
+    broadcasting across the leading batch axes.  This is the encode kernel:
+    one coded fragment is a random coefficient row combined over a holder's
+    basis rows.  The K axis is unrolled (K is a small static generation
+    size), so the peak intermediate is one [..., L] product per term — the
+    general ``gf_matmul`` materializes the full [..., M, K, L] product table
+    and is kept for the small decode-side solves.
+    """
+    k = rows.shape[-2]
+    acc = gf_mul(coeffs[..., 0:1], rows[..., 0, :])
+    for i in range(1, k):
+        acc = acc ^ gf_mul(coeffs[..., i : i + 1], rows[..., i, :])
+    return acc
+
+
+def gf_matmul(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Batched GF(256) matrix product: u8[..., M, K] x u8[..., K, N] ->
+    u8[..., M, N] (XOR-accumulated products over the contraction axis)."""
+    a32 = a.astype(jnp.int32)[..., :, :, None]   # [..., M, K, 1]
+    b32 = b.astype(jnp.int32)[..., None, :, :]   # [..., 1, K, N]
+    exp = jnp.asarray(GF_EXP)
+    log = jnp.asarray(GF_LOG)
+    prod = jnp.where(
+        (a32 == 0) | (b32 == 0), 0, exp[log[a32] + log[b32]]
+    ).astype(jnp.uint8)
+    return jax.lax.reduce(
+        prod, np.uint8(0), jax.lax.bitwise_xor, dimensions=(prod.ndim - 2,)
+    )
+
+
+def coeffs_by_uid(
+    key: jax.Array,
+    shape: Tuple[int, ...],
+    uid: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Random u8 coefficient draw keyed on canonical peer identity.
+
+    The coded-gossip twin of ``ops.gossip.uniform_by_uid``: row axis 0 is
+    the peer id, and a placement-relabeled run (``peer_uid`` set) gathers
+    the draw through the canonical ids so the coefficients a peer emits
+    depend on WHO it is, not where the placement put it.  ``uid=None`` is
+    the identity fast path.
+    """
+    r = jax.random.randint(key, shape, 0, 256, dtype=jnp.int32).astype(
+        jnp.uint8
+    )
+    return r if uid is None else r[uid]
+
+
+# ---------------------------------------------------------------------------
+# structured Gaussian elimination: the streaming decode-rank kernel
+# ---------------------------------------------------------------------------
+
+
+def rref_insert(basis: jax.Array, v: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Fold one received coefficient vector into a structured basis.
+
+    ``basis`` is u8[K, K] in *pivot-slot* form: row p is either all-zero
+    (absent) or has its first nonzero at column p, normalized to 1 —
+    presence is readable off the diagonal, so no separate rank counter is
+    carried.  The insert is the streaming half of Gaussian elimination:
+
+    1. forward-eliminate ``v`` against every present row in pivot order
+       (``fori_loop`` — after the sweep, v is zero at every present pivot);
+    2. the residual's first nonzero column p is an EMPTY slot; normalize by
+       ``gf_inv(v[p])`` and store there.
+
+    A dependent (or zero) vector leaves the basis unchanged.  Returns
+    ``(basis', inserted)``; rank is ``gf_rank(basis')``.  Fully traceable,
+    O(K^2) table lookups — ``vmap`` it over [peers, generations] and the
+    whole network's decode state advances as one batched kernel.
+    """
+    kk = basis.shape[-1]
+
+    def eliminate(p, vec):
+        present = basis[p, p] != 0
+        factor = jnp.where(present, vec[p], 0).astype(jnp.uint8)
+        return vec ^ gf_mul(jnp.broadcast_to(factor, (kk,)), basis[p])
+
+    v = jax.lax.fori_loop(0, kk, eliminate, v.astype(jnp.uint8))
+    nz = v != 0
+    inserted = nz.any()
+    p = jnp.argmax(nz)  # first nonzero column == the empty pivot slot
+    newrow = gf_mul(jnp.broadcast_to(gf_inv(v[p]), (kk,)), v)
+    basis = basis.at[p].set(jnp.where(inserted, newrow, basis[p]))
+    return basis, inserted
+
+
+def gf_rank(basis: jax.Array) -> jax.Array:
+    """i32[...]: occupied pivot-slot count of structured bases
+    (u8[..., K, K] as maintained by :func:`rref_insert`)."""
+    diag = jnp.diagonal(basis, axis1=-2, axis2=-1)
+    return (diag != 0).sum(axis=-1).astype(jnp.int32)
+
+
+@functools.partial(jax.jit)
+def gf_solve(a: jax.Array, b: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Solve ``A @ X = B`` over GF(256) by Gauss-Jordan with row pivoting.
+
+    ``a`` u8[K, K], ``b`` u8[K, L] -> ``(x, ok)`` with ``x`` u8[K, L] and
+    ``ok`` a bool scalar that is False when A is singular (x is then
+    garbage).  The full-solve twin of the streaming :func:`rref_insert`:
+    the decode path a receiver runs ONCE per generation, when its basis
+    hits full rank and the payload fragments get recovered.  Static K/L,
+    ``fori_loop`` over columns — device-side and vmap-able.
+    """
+    kk = a.shape[0]
+    ab = jnp.concatenate(
+        [a.astype(jnp.uint8), b.astype(jnp.uint8)], axis=1
+    )
+
+    def col(i, carry):
+        ab, ok = carry
+        cand = (jnp.arange(kk) >= i) & (ab[:, i] != 0)
+        ok = ok & cand.any()
+        piv = jnp.argmax(cand)
+        ri, rp = ab[i], ab[piv]
+        ab = ab.at[i].set(rp).at[piv].set(ri)
+        row = gf_mul(gf_inv(ab[i, i])[None], ab[i])
+        factors = jnp.where(jnp.arange(kk) == i, 0, ab[:, i]).astype(
+            jnp.uint8
+        )
+        ab = (ab ^ gf_mul(factors[:, None], row[None, :])).at[i].set(row)
+        return ab, ok
+
+    ab, ok = jax.lax.fori_loop(0, kk, col, (ab, jnp.asarray(True)))
+    return ab[:, kk:], ok

@@ -175,6 +175,27 @@ def degraded_links() -> ScenarioSpec:
     )
 
 
+def degraded_links_rlnc() -> ScenarioSpec:
+    """The degraded-link campaign on the CODED plane: same 64-peer graph
+    shape, a quarter of the peers behind lossy ingress (for rlnc the
+    window is DECIMATION — off-gate fragments are lost, not held), graded
+    by the same delivery SLO.  Rateless coding must ride through loss the
+    two-phase mesh needs IWANT round trips to repair."""
+    return ScenarioSpec(
+        name="degraded_links_rlnc",
+        family="rlnc",
+        n_steps=40,
+        seed=53,
+        model=dict(n_peers=64, n_slots=16, conn_degree=8, msg_window=64,
+                   gen_size=4),
+        workloads=[Workload(kind="constant", start=2, stop=24, every=2)],
+        links=[LinkWindow(start=6, stop=22, delay=2, frac=0.25)],
+        slo=SLO(min_delivery_frac=0.95),
+        description="25% of peers dropping 2 of 3 ingress rounds for 16 "
+                    "rounds, coded fabric (gen_size=4).",
+    )
+
+
 def tree_churn_heal() -> ScenarioSpec:
     """TreeCast under leave/kill churn with rejoin: the repair walk must
     re-attach everyone and drain the root's queue."""
@@ -283,6 +304,7 @@ CANON: Dict[str, Callable[[], ScenarioSpec]] = {
     "eclipse_backoff_spam": eclipse_backoff_spam,
     "spam_flood": spam_flood,
     "degraded_links": degraded_links,
+    "degraded_links_rlnc": degraded_links_rlnc,
     "tree_churn_heal": tree_churn_heal,
     "multitopic_hot_publisher": multitopic_hot_publisher,
     "root_kill_failover": root_kill_failover,

@@ -13,16 +13,20 @@ the model's ``rollout_events`` — the campaign executes in a single
 Model-family support matrix (unsupported combinations raise at compile
 time rather than silently dropping events):
 
-==============  =========  ========  ==========
-event           gossipsub  treecast  multitopic
-==============  =========  ========  ==========
-abrupt churn        x         x          x
-graceful churn      x         x
-rejoin              x         x          x
+==============  =========  ========  ==========  ====
+event           gossipsub  treecast  multitopic  rlnc
+==============  =========  ========  ==========  ====
+abrupt churn        x         x          x         x
+graceful churn      x         x                    x
+rejoin              x         x          x         x
 attack waves        x                spam kinds
-link windows        x                    x
-workloads           x       (root)       x
-==============  =========  ========  ==========
+link windows        x                    x         x
+workloads           x       (root)       x         x
+==============  =========  ========  ==========  ====
+
+(rlnc has no mesh/score plane, so attack waves do not lower; its link
+windows install ingress DECIMATION — fragments outside the accept gate
+are lost, not held — see ``models/rlnc.py``.)
 """
 
 from __future__ import annotations
@@ -80,6 +84,12 @@ def build_model(spec: ScenarioSpec, graft_spammers=None):
         if graft_spammers is not None:
             raise ValueError("graft_spam waves are gossipsub-only")
         return MultiTopicGossipSub(**_split_model_kwargs(spec))
+    if spec.family == "rlnc":
+        from ..models.rlnc import RLNC
+
+        if graft_spammers is not None:
+            raise ValueError("graft_spam waves are gossipsub-only")
+        return RLNC(**dict(spec.model))
     # treecast: model kwargs split into SimParams / TreeOpts fields.
     from ..models.treecast import TreeCast
 
@@ -148,6 +158,12 @@ def _compile_gossip_like(spec: ScenarioSpec) -> CompiledScenario:
     import jax.numpy as jnp
 
     T, multitopic = spec.n_steps, spec.family == "multitopic"
+    rlnc = spec.family == "rlnc"
+    if rlnc and spec.attacks:
+        raise ValueError(
+            "attack waves are not lowered for rlnc (no mesh/score plane "
+            "to eclipse, spam or graft against)"
+        )
 
     # -- model + state (eclipse needs the converged mesh, so init first;
     #    graft_spam rebinds the constructor and re-inits with the same seed,
@@ -239,7 +255,8 @@ def _compile_gossip_like(spec: ScenarioSpec) -> CompiledScenario:
             if w.kind == "eclipse":
                 events.silence[start:stop] |= attackers[None, :]
 
-    if not multitopic and events.silence.any() and model.max_edge_delay:
+    if not multitopic and not rlnc and events.silence.any() \
+            and model.max_edge_delay:
         raise ValueError(
             "eclipse silence requires the ideal eager fabric "
             "(max_edge_delay == 0): squelching fresh_w would desync the "

@@ -28,7 +28,7 @@ import json
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
-FAMILIES = ("gossipsub", "treecast", "multitopic")
+FAMILIES = ("gossipsub", "treecast", "multitopic", "rlnc")
 WORKLOAD_KINDS = ("constant", "burst", "hot")
 ATTACK_KINDS = ("sybil", "eclipse", "spam", "promise_spam", "graft_spam")
 

@@ -1,0 +1,372 @@
+"""Coded gossip (RLNC) test suite: GF(256) field properties, encode/decode
+against a pure-numpy reference, the K-of-N any-subset decode guarantee, the
+model's propagation + recorder surfaces, and the canon scenario gate.
+
+The property sweeps are plain numpy randomized batches (NOT hypothesis —
+the container does not ship it, and ``tests/test_properties.py`` already
+fails collection for that reason); the field is tiny enough that inverse
+and roundtrip laws are checked EXHAUSTIVELY over all 255 nonzero elements,
+and the two-operand laws over dense random samples.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from go_libp2p_pubsub_tpu.ops import gf256
+
+
+# ---------------------------------------------------------------------------
+# pure-numpy reference: Russian-peasant GF(256) multiply, no tables
+# ---------------------------------------------------------------------------
+
+def ref_mul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Bitwise carry-less multiply mod 0x11B — the table-free reference the
+    log/antilog implementation is asserted against."""
+    a = a.astype(np.int32).copy()
+    b = b.astype(np.int32).copy()
+    acc = np.zeros_like(a)
+    for _ in range(8):
+        acc ^= np.where(b & 1, a, 0)
+        b >>= 1
+        hi = a & 0x80
+        a = (a << 1) & 0xFF
+        a ^= np.where(hi, 0x11B & 0xFF, 0)
+    return acc.astype(np.uint8)
+
+
+def ref_matmul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    out = np.zeros((a.shape[0], b.shape[1]), np.uint8)
+    for i in range(a.shape[0]):
+        for j in range(b.shape[1]):
+            acc = 0
+            for k in range(a.shape[1]):
+                acc ^= int(ref_mul(a[i, k], b[k, j]))
+            out[i, j] = acc
+    return out
+
+
+# ---------------------------------------------------------------------------
+# field axioms + table roundtrip
+# ---------------------------------------------------------------------------
+
+def test_log_antilog_roundtrip_exhaustive():
+    """exp(log(a)) == a for every nonzero element, and the doubled antilog
+    table really repeats with period 255 (the no-mod hot path contract)."""
+    nz = np.arange(1, 256)
+    assert (gf256.GF_EXP[gf256.GF_LOG[nz]] == nz).all()
+    assert (gf256.GF_EXP[255:510] == gf256.GF_EXP[0:255]).all()
+    # log is a bijection 1..255 -> 0..254
+    assert sorted(gf256.GF_LOG[nz].tolist()) == list(range(255))
+
+
+def test_gf_mul_matches_reference():
+    rng = np.random.default_rng(0)
+    a = rng.integers(0, 256, 4096).astype(np.uint8)
+    b = rng.integers(0, 256, 4096).astype(np.uint8)
+    got = np.asarray(gf256.gf_mul(jnp.asarray(a), jnp.asarray(b)))
+    assert (got == ref_mul(a, b)).all()
+    # zero absorbs on both sides
+    assert (np.asarray(gf256.gf_mul(jnp.asarray(a), jnp.zeros(4096,
+            jnp.uint8))) == 0).all()
+
+
+def test_field_axioms_random_sweep():
+    """Commutativity, associativity, distributivity over dense random
+    batches; identity and inverse laws exhaustively."""
+    rng = np.random.default_rng(1)
+    a, b, c = (jnp.asarray(rng.integers(0, 256, 8192).astype(np.uint8))
+               for _ in range(3))
+    mul = gf256.gf_mul
+    assert bool((mul(a, b) == mul(b, a)).all())
+    assert bool((mul(a, mul(b, c)) == mul(mul(a, b), c)).all())
+    assert bool((mul(a, b ^ c) == (mul(a, b) ^ mul(a, c))).all())
+    every = jnp.arange(256, dtype=jnp.uint8)
+    assert bool((mul(every, jnp.uint8(1)) == every).all())
+    inv = gf256.gf_inv(every)
+    prod = np.asarray(mul(every, inv))
+    assert prod[0] == 0 and (prod[1:] == 1).all()
+
+
+def test_gf_matmul_and_combine_match_reference():
+    rng = np.random.default_rng(2)
+    a = rng.integers(0, 256, (5, 4)).astype(np.uint8)
+    b = rng.integers(0, 256, (4, 7)).astype(np.uint8)
+    ref = ref_matmul(a, b)
+    assert (np.asarray(gf256.gf_matmul(jnp.asarray(a), jnp.asarray(b)))
+            == ref).all()
+    # gf_combine is one row of the same product, batched over the row axis
+    got = np.asarray(gf256.gf_combine(jnp.asarray(a), jnp.asarray(b)[None]))
+    assert (got == ref).all()
+
+
+# ---------------------------------------------------------------------------
+# encode/decode: streaming elimination + full solve
+# ---------------------------------------------------------------------------
+
+def test_rref_insert_rank_and_dependence():
+    """K independent inserts fill the basis; any further vector — including
+    explicit GF-linear combinations of what was inserted — is rejected."""
+    rng = np.random.default_rng(3)
+    K = 5
+    basis = jnp.zeros((K, K), jnp.uint8)
+    rows = []
+    inserted_count = 0
+    while inserted_count < K:
+        v = rng.integers(0, 256, K).astype(np.uint8)
+        basis, ins = gf256.rref_insert(basis, jnp.asarray(v))
+        if bool(ins):
+            rows.append(v)
+            inserted_count += 1
+        assert int(gf256.gf_rank(basis)) == inserted_count
+    # a random combination of the inserted rows must be dependent
+    coeff = rng.integers(0, 256, K).astype(np.uint8)
+    combo = np.zeros(K, np.uint8)
+    for c, r in zip(coeff, rows):
+        combo ^= ref_mul(np.full(K, c, np.uint8), r)
+    basis2, ins = gf256.rref_insert(basis, jnp.asarray(combo))
+    assert not bool(ins)
+    assert (np.asarray(basis2) == np.asarray(basis)).all()
+    # zero vector is a no-op (the model's masking relies on this)
+    _, ins = gf256.rref_insert(basis, jnp.zeros(K, jnp.uint8))
+    assert not bool(ins)
+
+
+def test_encode_decode_roundtrip_vs_numpy():
+    """Payload -> coded fragments (device encode) -> gf_solve recovers the
+    payload, with the coded fragments themselves asserted against the
+    pure-numpy reference encode."""
+    rng = np.random.default_rng(4)
+    K, L = 6, 9
+    payload = rng.integers(0, 256, (K, L)).astype(np.uint8)
+    coeffs = rng.integers(0, 256, (K, K)).astype(np.uint8)
+    frags = np.asarray(gf256.gf_matmul(jnp.asarray(coeffs),
+                                       jnp.asarray(payload)))
+    assert (frags == ref_matmul(coeffs, payload)).all()
+    x, ok = gf256.gf_solve(jnp.asarray(coeffs), jnp.asarray(frags))
+    assert bool(ok)
+    assert (np.asarray(x) == payload).all()
+
+
+def test_k_of_n_any_subset_decode():
+    """The RLNC guarantee (acceptance criterion): with N > K coded
+    fragments, ANY K-subset whose coefficient rows are independent decodes
+    the exact payload; dependent subsets are flagged, never mis-decoded."""
+    rng = np.random.default_rng(5)
+    K, N, L = 4, 10, 6
+    payload = rng.integers(0, 256, (K, L)).astype(np.uint8)
+    coeffs = rng.integers(0, 256, (N, K)).astype(np.uint8)
+    frags = np.asarray(gf256.gf_matmul(jnp.asarray(coeffs),
+                                       jnp.asarray(payload)))
+    decoded = dependent = 0
+    from itertools import combinations
+    for sub in combinations(range(N), K):
+        a = jnp.asarray(coeffs[list(sub)])
+        b = jnp.asarray(frags[list(sub)])
+        x, ok = gf256.gf_solve(a, b)
+        # independence judged by the streaming kernel — both decode paths
+        # must agree on which subsets are decodable
+        basis = jnp.zeros((K, K), jnp.uint8)
+        for r in list(sub):
+            basis, _ = gf256.rref_insert(basis, jnp.asarray(coeffs[r]))
+        assert bool(ok) == (int(gf256.gf_rank(basis)) == K)
+        if bool(ok):
+            assert (np.asarray(x) == payload).all()
+            decoded += 1
+        else:
+            dependent += 1
+    # random u8 coefficients are independent with overwhelming probability:
+    # nearly every subset must actually decode
+    assert decoded > 0.9 * (decoded + dependent)
+
+
+# ---------------------------------------------------------------------------
+# model: propagation, recorder, events, degraded links
+# ---------------------------------------------------------------------------
+
+def _small_model():
+    from go_libp2p_pubsub_tpu.models.rlnc import RLNC
+
+    return RLNC(n_peers=24, n_slots=8, conn_degree=4, msg_window=6,
+                gen_size=3)
+
+
+def test_rlnc_full_delivery_and_latency_floor():
+    m = _small_model()
+    st = m.init(seed=11)
+    st = m.publish(st, jnp.int32(2), jnp.int32(0), jnp.asarray(True))
+    out, rec = m.rollout(st, 12, record=True)
+    frac, p50, p99 = m.delivery_stats(out)
+    assert float(frac[0]) == 1.0
+    # publisher delivered at latency 0, everyone else needs >= 1 round
+    assert int(out.first_step[2, 0]) == 0
+    assert float(p50) >= 1.0 and float(p99) <= 12.0
+    # recorder channel contract (the SLO plane reads these)
+    assert float(np.asarray(rec["delivery_frac"])[-1]) == 1.0
+    assert int(np.asarray(rec["lat_hist"])[-1].sum()) == 24
+    assert int(np.asarray(rec["peers_alive"])[-1]) == 24
+    # backlog drains to zero once every basis is full rank
+    assert int(np.asarray(rec["gossip_pending"])[-1]) == 0
+
+
+def test_rlnc_invalid_generation_never_relays():
+    m = _small_model()
+    st = m.init(seed=11)
+    st = m.publish(st, jnp.int32(2), jnp.int32(0), jnp.asarray(False))
+    out = m.run(st, 8)
+    rank = np.asarray(m.rank(out))
+    assert int((rank[:, 0] > 0).sum()) <= 1  # publisher only
+
+
+def test_rlnc_degraded_ingress_delays_but_completes():
+    """Decimated peers (accept 1 round in 3, the rest LOST) still decode —
+    the rateless-coding property the whole model exists for — just later."""
+    m = _small_model()
+    st0 = m.init(seed=13)
+    st0 = m.publish(st0, jnp.int32(0), jnp.int32(0), jnp.asarray(True))
+    clean, _ = m.rollout(st0, 20, record=False)
+    delay = jnp.where(jnp.arange(24) % 3 == 1, 2, 0)
+    deg, _ = m.rollout(m.set_gossip_delay(st0, delay), 20, record=False)
+    f_c, p50_c, _ = m.delivery_stats(clean)
+    f_d, p50_d, _ = m.delivery_stats(deg)
+    assert float(f_c[0]) == 1.0 and float(f_d[0]) == 1.0
+    assert float(p50_d) >= float(p50_c)
+    # a decimated peer's receipt can only land on an accept round
+    cohort = np.flatnonzero(np.asarray(delay) > 0)
+    stamps = np.asarray(deg.first_step)[cohort, 0]
+    assert ((stamps % 3) == 0).all()
+
+
+def test_rlnc_kill_and_mute():
+    m = _small_model()
+    st = m.init(seed=17)
+    st = m.publish(st, jnp.int32(0), jnp.int32(0), jnp.asarray(True))
+    dead = jnp.zeros(24, bool).at[5].set(True)
+    st = m.kill_peers(st, dead)
+    out = m.run(st, 12)
+    first = np.asarray(out.first_step)[:, 0]
+    assert first[5] < 0  # dead peers never decode
+    alive = np.ones(24, bool)
+    alive[5] = False
+    assert (first[alive] >= 0).all()
+    # mute: receive-only peers decode but the rest of the mesh still
+    # completes without their emissions
+    st2 = m.init(seed=17)
+    st2 = m.publish(st2, jnp.int32(0), jnp.int32(0), jnp.asarray(True))
+    st2 = m.set_gossip_mute(st2, jnp.zeros(24, bool).at[3].set(True))
+    out2 = m.run(st2, 12)
+    assert (np.asarray(out2.first_step)[:, 0] >= 0).all()
+
+
+def test_rlnc_rollout_events_matches_manual_publish():
+    """The scenario plane's executor: an events tensor with one publish
+    row must reproduce manual publish + rollout, self-receipt included."""
+    from go_libp2p_pubsub_tpu.ops import schedule as sched
+
+    m = _small_model()
+    st = m.init(seed=19)
+    events = sched.empty_gossip_events(10, 24, 1)
+    sched.add_publish(events, 2, {"src": 4, "slot": 0, "valid": True})
+    events = jax.tree_util.tree_map(jnp.asarray, events)
+    out, rec = m.rollout_events(st, events, record=True)
+    frac, _, _ = m.delivery_stats(out)
+    assert float(frac[0]) == 1.0
+    assert int(np.asarray(rec["lat_hist"])[-1].sum()) == 24
+    assert float(np.asarray(rec["delivery_frac"])[-1]) == 1.0
+
+
+def test_rlnc_config_value_semantics():
+    """Equal-config models must hash/compare equal (the jit-cache
+    contract every other model honors)."""
+    from go_libp2p_pubsub_tpu.models.rlnc import RLNC
+
+    a = RLNC(n_peers=24, n_slots=8, conn_degree=4, msg_window=6, gen_size=3)
+    b = RLNC(n_peers=24, n_slots=8, conn_degree=4, msg_window=6, gen_size=3)
+    c = RLNC(n_peers=24, n_slots=8, conn_degree=4, msg_window=6, gen_size=4)
+    assert a == b and hash(a) == hash(b)
+    assert a != c
+
+
+def test_rlnc_same_seed_same_graph_as_gossipsub():
+    """The head-to-head bench's topology guarantee: identical n/k/degree/
+    seed -> bit-identical graph across the two model families."""
+    from go_libp2p_pubsub_tpu.models.gossipsub import GossipSub
+    from go_libp2p_pubsub_tpu.models.rlnc import RLNC
+
+    rl = RLNC(n_peers=48, n_slots=8, conn_degree=4, msg_window=4,
+              gen_size=2)
+    gs = GossipSub(n_peers=48, n_slots=8, conn_degree=4, msg_window=4,
+                   use_pallas=False)
+    rn, rr, rv = rl.build_graph(seed=5)
+    gn, gr, gv, _ = gs.build_graph(seed=5)
+    assert bool(jnp.array_equal(rn, gn))
+    assert bool(jnp.array_equal(rr, gr))
+    assert bool(jnp.array_equal(rv, gv))
+
+
+# ---------------------------------------------------------------------------
+# scenario + canon
+# ---------------------------------------------------------------------------
+
+def test_rlnc_scenario_compiles_and_rejects_attacks():
+    from go_libp2p_pubsub_tpu import scenario
+    from go_libp2p_pubsub_tpu.scenario.spec import AttackWave, ScenarioSpec
+
+    spec = scenario.build("degraded_links_rlnc")
+    comp = scenario.compile_scenario(spec)
+    assert type(comp.model).__name__ == "RLNC"
+    assert not scenario.live_supported(spec)
+    with pytest.raises(ValueError, match="not lowered for rlnc"):
+        scenario.compile_scenario(
+            ScenarioSpec(
+                name="x", family="rlnc", n_steps=8, seed=1,
+                model=dict(n_peers=16, n_slots=8, conn_degree=4,
+                           msg_window=4, gen_size=2),
+                attacks=[AttackWave(kind="spam", n_attackers=1,
+                                    spam_every=1)],
+            )
+        )
+
+
+def test_degraded_links_rlnc_canon_green():
+    """Acceptance criterion: the canon scenario passes its SLO on CPU."""
+    from go_libp2p_pubsub_tpu import scenario
+
+    res = scenario.run_scenario(scenario.build("degraded_links_rlnc"))
+    assert res.verdict.passed, str(res.verdict)
+    names = {c.name for c in res.verdict.criteria}
+    assert "delivery_frac" in names
+
+
+# ---------------------------------------------------------------------------
+# head-to-head bench (slow: runs the BENCH_MODE=rlnc child end to end)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_bench_rlnc_head_to_head_child():
+    """The BENCH_MODE=rlnc child emits the head-to-head section at a tiny
+    override scale: both pipelines, both conditions, real signed window."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(
+        os.environ, BENCH_MODE="rlnc", JAX_PLATFORMS="cpu",
+        BENCH_RLNC_PEERS="64", BENCH_RLNC_STEPS="12",
+    )
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"), "--child"],
+        env=env, timeout=600, stdout=subprocess.PIPE,
+    )
+    assert r.returncode == 0, r.stdout[-500:]
+    rec = json.loads(r.stdout.decode().strip().splitlines()[-1])
+    assert rec["metric"] == "rlnc_validated_msgs_per_sec"
+    for cond in ("clean", "degraded"):
+        for pipeline in ("rlnc", "eager_iwant"):
+            sec = rec[cond][pipeline]
+            assert sec["delivery_frac"] > 0.99
+            assert sec["p99_latency_rounds"] >= sec["p50_latency_rounds"]
+            assert sec["msgs_per_sec"] > 0

@@ -63,6 +63,16 @@ def test_all_families_trace_smoke():
     attackers = jnp.zeros((g.n,), bool).at[0].set(True)
     jax.eval_shape(lambda s: _attacker_metrics(g, s, attackers), g_st)
 
+    # -- rlnc: coded gossip (trace covers the GF(256) elimination fold) ----
+    from go_libp2p_pubsub_tpu.models.rlnc import RLNC
+
+    rl = RLNC(n_peers=16, n_slots=8, conn_degree=4, msg_window=4, gen_size=2)
+    rl_st = jax.eval_shape(lambda: rl.init(seed=0))
+    jax.eval_shape(
+        rl.publish, rl_st, jnp.int32(0), jnp.int32(0), jnp.asarray(True)
+    )
+    jax.eval_shape(rl.step, rl_st)
+
     # -- treecast / floodsub (cheap anyway, but keep the tier complete) ----
     from go_libp2p_pubsub_tpu.config import SimParams, TreeOpts
     from go_libp2p_pubsub_tpu.models.floodsub import FloodSub
@@ -108,6 +118,20 @@ def test_gossipsub_smoke():
     st = gs.publish(st, jnp.int32(0), jnp.int32(0), jnp.asarray(True))
     st = gs.step(st)
     assert int(st.step) == 1
+
+
+def test_rlnc_smoke():
+    """Coded gossip: publish a generation, run a few rounds, every peer's
+    basis must reach full rank (a delivery receipt per peer)."""
+    from go_libp2p_pubsub_tpu.models.rlnc import RLNC
+
+    rl = RLNC(n_peers=16, n_slots=8, conn_degree=4, msg_window=4, gen_size=2)
+    st = rl.init(seed=0)
+    st = rl.publish(st, jnp.int32(0), jnp.int32(0), jnp.asarray(True))
+    st = rl.run(st, 8)
+    frac, p50, _ = rl.delivery_stats(st)
+    assert float(frac[0]) == 1.0
+    assert float(p50) >= 1.0  # non-publishers need >= 1 coded round
 
 
 @pytest.mark.slow

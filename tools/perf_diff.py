@@ -53,6 +53,33 @@ SCALAR_ROWS: List[Tuple[Tuple[str, ...], str, bool]] = [
     (("sharded", "edge_cut", "cut_frac"), "sharded cut frac", False),
     (("sharded", "edge_cut", "cut_reduction_vs_random"),
      "sharded cut reduction vs random", True),
+    # Coded-gossip head-to-head section (r11+); same warn-not-crash
+    # behavior as sharded when a record lacks it.
+    (("rlnc", "value"), "rlnc msgs/sec", True),
+    (("rlnc", "clean", "rlnc", "p50_latency_rounds"),
+     "rlnc clean p50 (rounds)", False),
+    (("rlnc", "clean", "rlnc", "p99_latency_rounds"),
+     "rlnc clean p99 (rounds)", False),
+    (("rlnc", "clean", "rlnc", "delivery_frac"),
+     "rlnc clean delivery frac", True),
+    (("rlnc", "clean", "eager_iwant", "msgs_per_sec"),
+     "eager clean msgs/sec", True),
+    (("rlnc", "clean", "eager_iwant", "p99_latency_rounds"),
+     "eager clean p99 (rounds)", False),
+    (("rlnc", "degraded", "rlnc", "msgs_per_sec"),
+     "rlnc degraded msgs/sec", True),
+    (("rlnc", "degraded", "rlnc", "p50_latency_rounds"),
+     "rlnc degraded p50 (rounds)", False),
+    (("rlnc", "degraded", "rlnc", "p99_latency_rounds"),
+     "rlnc degraded p99 (rounds)", False),
+    (("rlnc", "degraded", "rlnc", "delivery_frac"),
+     "rlnc degraded delivery frac", True),
+    (("rlnc", "degraded", "eager_iwant", "msgs_per_sec"),
+     "eager degraded msgs/sec", True),
+    (("rlnc", "degraded", "eager_iwant", "p99_latency_rounds"),
+     "eager degraded p99 (rounds)", False),
+    (("rlnc", "degraded", "eager_iwant", "delivery_frac"),
+     "eager degraded delivery frac", True),
 ]
 
 
@@ -196,6 +223,27 @@ def context_warnings(old: Dict[str, Any], new: Dict[str, Any]) -> List[str]:
                 warns.append(
                     f"sharded {key} differs: {so.get(key)!r} vs "
                     f"{sn.get(key)!r}"
+                )
+    # Coded-gossip section (r11+): same treatment.
+    ro, rn = old.get("rlnc"), new.get("rlnc")
+    if (ro is None) != (rn is None):
+        which = "old" if ro is None else "new"
+        warns.append(
+            f"only one record has an 'rlnc' section (missing in {which}; "
+            f"added in r11) — rlnc rows are one-sided"
+        )
+    for name, s in (("old", ro), ("new", rn)):
+        if isinstance(s, dict) and "error" in s:
+            warns.append(
+                f"{name} rlnc section is an error record: "
+                f"{str(s['error'])[:200]}"
+            )
+    if (isinstance(ro, dict) and isinstance(rn, dict)
+            and "error" not in ro and "error" not in rn):
+        for key in ("backend", "n_peers", "gen_size"):
+            if ro.get(key) != rn.get(key):
+                warns.append(
+                    f"rlnc {key} differs: {ro.get(key)!r} vs {rn.get(key)!r}"
                 )
     return warns
 
