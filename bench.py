@@ -91,6 +91,21 @@ RLNC_SCALE = dict(n_peers=1024, n_slots=16, degree=8, gen_size=8,
                   degraded_delay=2)
 RLNC_RUN_TIMEOUT_S = 900.0
 
+# Streaming serving plane (BENCH_MODE=streaming): ONE resident multitopic
+# rollout (serve.engine) fed an open publish stream through the ingest ring
+# (serve.ingest), with the signed window verified INLINE ahead of enqueue —
+# signature verification is on the measured path, unlike the closed-loop
+# headline's amortized 8192-batch charge.  Three workloads (constant, burst,
+# hot publisher) share the one engine so the whole mode compiles its chunk
+# exactly once; message budgets keep every (topic, slot) unique so delivery
+# stays exactly accountable (no window recycling mid-bench).
+STREAMING_SCALE = dict(n_topics=2, n_peers=256, n_slots=16, degree=8,
+                       msg_window=128, heartbeat_steps=4,
+                       chunk_steps=8, pub_width=8, capacity=128,
+                       n_constant=96, n_burst=64, n_hot=64,
+                       completion_frac=0.99)
+STREAMING_RUN_TIMEOUT_S = 900.0
+
 PROBE_TIMEOUT_S = 180.0
 # The r3 TPU run took ~4.5 min, and the r5 child adds the device-kernel
 # scaling curve (4 compiled batch shapes) and the phase-breakdown compiles,
@@ -233,6 +248,30 @@ def _run_rlnc_child(probe_ok: bool) -> dict:
     return {"error": " | ".join(a[:300] for a in attempts)}
 
 
+def _run_streaming_child(probe_ok: bool) -> dict:
+    """Run the BENCH_MODE=streaming child (resident rollout + ingest ring
+    under sustained load).  Accelerator first when the probe passed, CPU
+    fallback otherwise; failure becomes an ``error`` dict, never a crash."""
+    attempts = []
+    if probe_ok:
+        parsed, tail = run_child(
+            {"BENCH_MODE": "streaming"}, STREAMING_RUN_TIMEOUT_S
+        )
+        if parsed is not None:
+            return parsed
+        attempts.append(f"accelerator attempt: {tail}")
+        log("orchestrator: streaming accelerator child failed; "
+            "retrying on CPU")
+    parsed, tail = run_child(
+        {"BENCH_MODE": "streaming", "JAX_PLATFORMS": "cpu"},
+        STREAMING_RUN_TIMEOUT_S,
+    )
+    if parsed is not None:
+        return parsed
+    attempts.append(f"cpu attempt: {tail}")
+    return {"error": " | ".join(a[:300] for a in attempts)}
+
+
 def orchestrate() -> None:
     attempts = []
     record = None
@@ -282,6 +321,12 @@ def orchestrate() -> None:
     if os.environ.get("BENCH_RLNC", "1") != "0":
         log("orchestrator: running rlnc child (BENCH_MODE=rlnc)")
         record["rlnc"] = _run_rlnc_child(probe_ok)
+
+    # Streaming serving plane rides along the same way
+    # (tools/perf_diff.py diffs it; BENCH_STREAMING=0 skips it).
+    if os.environ.get("BENCH_STREAMING", "1") != "0":
+        log("orchestrator: running streaming child (BENCH_MODE=streaming)")
+        record["streaming"] = _run_streaming_child(probe_ok)
 
     print(json.dumps(record))
 
@@ -868,12 +913,197 @@ def rlnc_child_main() -> None:
     )
 
 
+def streaming_child_main() -> None:
+    """BENCH_MODE=streaming: sustained-load serving bench (ISSUE 7
+    tentpole).  One resident multitopic engine, compiled once, fed three
+    workloads through the ingest ring with signature verification INLINE
+    ahead of every enqueue.  Reported latencies are exact host-clock
+    ingest→delivery, quantized to chunk boundaries (delivery is observed
+    when the chunk that crossed the completion threshold returns).  Emits
+    one JSON line the orchestrator nests under ``streaming``."""
+    import jax
+    import numpy as np
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from go_libp2p_pubsub_tpu.crypto import native
+    from go_libp2p_pubsub_tpu.crypto.pipeline import (
+        Envelope,
+        ValidationPipeline,
+        sign_envelope,
+    )
+    from go_libp2p_pubsub_tpu.models.multitopic import MultiTopicGossipSub
+    from go_libp2p_pubsub_tpu.serve import IngestRing, StreamingEngine
+    from go_libp2p_pubsub_tpu.utils.metrics import quantiles
+
+    cfg = STREAMING_SCALE
+    n_peers = int(os.environ.get("BENCH_STREAMING_PEERS", cfg["n_peers"]))
+    n_msgs = int(os.environ.get("BENCH_STREAMING_MSGS", cfg["n_constant"]))
+    n_burst = min(cfg["n_burst"], max(4, 2 * n_msgs // 3))
+    n_hot = min(cfg["n_hot"], max(4, 2 * n_msgs // 3))
+    # Slot budget: topic 0 takes constant/2 + burst, topic 1 constant/2 +
+    # hot; both must fit the window or delivery becomes unaccountable.
+    assert n_msgs // 2 + max(n_burst, n_hot) <= cfg["msg_window"], \
+        "streaming bench overflows the message window"
+    dev = jax.devices()[0]
+    backend = dev.device_kind
+    log(f"streaming bench: {backend}  n_peers={n_peers}  "
+        f"constant={n_msgs} burst={n_burst} hot={n_hot}")
+
+    model = MultiTopicGossipSub(
+        n_topics=cfg["n_topics"], n_peers=n_peers,
+        n_slots=cfg["n_slots"], conn_degree=cfg["degree"],
+        msg_window=cfg["msg_window"],
+        heartbeat_steps=cfg["heartbeat_steps"],
+    )
+    ring = IngestRing(capacity=cfg["capacity"], policy="block")
+    engine = StreamingEngine(
+        model, ring, chunk_steps=cfg["chunk_steps"],
+        pub_width=cfg["pub_width"],
+        completion_frac=cfg["completion_frac"], seed=0,
+    )
+    t0 = time.perf_counter()
+    engine.warmup()
+    warmup_s = time.perf_counter() - t0
+    log(f"engine warm (compile+first chunk {warmup_s:.1f}s)")
+
+    crypto_backend = "native" if native.available() else "python"
+    pipe = ValidationPipeline(
+        backend=crypto_backend, flush_threshold=1 << 20,
+        on_verdict_ctx=lambda env, ok, ctx: ring.push(
+            topic=ctx[0], payload=env.payload, publisher=ctx[1],
+            valid=ok, timeout=30.0,
+        ),
+    )
+    rng = np.random.default_rng(2)
+    seqno = 0
+
+    def submit(topic, src, forged=False):
+        nonlocal seqno
+        seed = rng.bytes(32)
+        env = sign_envelope(
+            seed, f"topic-{topic}", seqno, b"stream payload %d" % seqno,
+            backend=crypto_backend,
+        )
+        if forged:
+            # Tamper post-signing: the INLINE verify stage, not a spec bit,
+            # must produce the False verdict that gates device relay.
+            env = Envelope(env.topic, env.seqno, env.payload + b"!",
+                           env.pubkey, env.signature)
+        pipe.submit(env, ctx=(topic, src))
+        seqno += 1
+
+    participants = float(n_peers)  # no churn on this plane: all subscribed
+
+    def measure(name, feed):
+        """Run one workload: ``feed`` yields per-chunk publish groups."""
+        ring.max_depth = 0  # per-workload peak (pure reporting state)
+        acct0 = ring.accounting()
+        lat0 = len(engine.latencies_s)
+        done0, pub0 = engine.completed, len(engine.publish_log)
+        t0 = time.perf_counter()
+        for group in feed:
+            for topic, src, forged in group:
+                submit(topic, src, forged)
+            pipe.flush()          # verify inline, enqueue via verdicts
+            engine.run_chunk()
+        # Drain: the stream stopped, deliveries must complete.
+        engine.run_until_drained(max_chunks=64)
+        elapsed = time.perf_counter() - t0
+        acct = ring.accounting()
+        lats = engine.latencies_s[lat0:]
+        q = quantiles(lats)
+        delivered = engine.completed - done0
+        published = len(engine.publish_log) - pub0
+        rate = delivered * participants / elapsed
+        log(f"{name}: {rate:,.0f} msgs/s  delivered {delivered}/{published}"
+            f"  p50 {q['p50']*1e3:.1f}ms p99 {q['p99']*1e3:.1f}ms"
+            f"  depth<= {ring.max_depth}  ({elapsed:.2f}s)")
+        return {
+            "sustained_msgs_per_sec": round(rate, 1),
+            "ingest_p50_s": round(q["p50"], 6),
+            "ingest_p99_s": round(q["p99"], 6),
+            "delivered": delivered,
+            "published": published,
+            "max_queue_depth": ring.max_depth,
+            "silent_drops": acct["silent_drops"] - acct0["silent_drops"],
+            "elapsed_s": round(elapsed, 3),
+        }
+
+    P = cfg["pub_width"]
+
+    def constant_feed():
+        msgs = [(i % 2, int(rng.integers(n_peers)), i < N_FORGED)
+                for i in range(n_msgs)]
+        for i in range(0, len(msgs), P):
+            yield msgs[i : i + P]
+
+    def burst_feed():
+        # Flash crowd: everything lands in the ring before the first chunk.
+        yield [(0, int(rng.integers(n_peers)), False) for _ in range(n_burst)]
+
+    def hot_feed():
+        msgs = [(1, 3, False) for _ in range(n_hot)]
+        for i in range(0, len(msgs), P):
+            yield msgs[i : i + P]
+
+    sections = {
+        "constant": measure("constant", constant_feed()),
+        "burst": measure("burst", burst_feed()),
+        "hot": measure("hot", hot_feed()),
+    }
+
+    # Forged messages (tampered inline, pushed valid=False) must not have
+    # propagated past their publisher.
+    digest = jax.device_get(model.stream_digest(engine.state))
+    for topic, slot in engine.invalid_published:
+        assert int(digest["delivered"][topic, slot]) <= 1, \
+            f"forged message propagated (topic {topic} slot {slot})"
+    assert len(engine.invalid_published) == N_FORGED
+
+    cache = engine.compile_cache_size()
+    record = {
+        "metric": "streaming_validated_msgs_per_sec",
+        "value": sections["constant"]["sustained_msgs_per_sec"],
+        "unit": "msgs/sec",
+        "methodology_version": 2,
+        "backend": backend,
+        "n_peers": n_peers,
+        "n_topics": cfg["n_topics"],
+        "chunk_steps": cfg["chunk_steps"],
+        "pub_width": cfg["pub_width"],
+        "capacity": cfg["capacity"],
+        "policy": "block",
+        "crypto_backend": crypto_backend,
+        "verify_inline": True,
+        "latency_note": (
+            "exact host-clock ingest->delivery, quantized UP to the chunk "
+            "boundary where the completion threshold was observed"
+        ),
+        "compile": {
+            "chunks_total": engine.chunks_run,
+            "cache_size": cache,
+            "compiled_once": cache == 1,
+        },
+        "warmup_s": round(warmup_s, 2),
+        "constant": sections["constant"],
+        "burst": sections["burst"],
+        "hot": sections["hot"],
+    }
+    assert record["compile"]["compiled_once"], \
+        f"resident chunk recompiled (cache_size={cache})"
+    print(json.dumps(record), flush=True)
+
+
 def child_main() -> None:
     mode = os.environ.get("BENCH_MODE", "tpu")
     if mode == "sharded":
         return sharded_child_main()
     if mode == "rlnc":
         return rlnc_child_main()
+    if mode == "streaming":
+        return streaming_child_main()
     scale = TPU_SCALE if mode == "tpu" else CPU_SCALE
 
     import jax

@@ -135,17 +135,22 @@ class ValidationPipeline:
         backend: Backend = "native",
         flush_threshold: int = 256,
         on_verdict: Callable[[Envelope, bool], None] | None = None,
+        on_verdict_ctx: Callable[[Envelope, bool, object], None] | None = None,
     ) -> None:
         if backend not in _BACKENDS:
             raise ValueError(f"unknown backend {backend!r}")
         self.backend = backend
         self.flush_threshold = flush_threshold
         self.on_verdict = on_verdict
-        self._pending: List[Envelope] = []
+        self.on_verdict_ctx = on_verdict_ctx
+        self._pending: List[Tuple[Envelope, object]] = []
         self.stats = {"validated": 0, "accepted": 0, "rejected": 0}
 
-    def submit(self, env: Envelope) -> None:
-        self._pending.append(env)
+    def submit(self, env: Envelope, ctx: object = None) -> None:
+        """Queue an envelope; ``ctx`` is opaque caller state (e.g. the
+        streaming plane's routing tuple) handed back via ``on_verdict_ctx``
+        so verdict delivery needs no side-channel lookup."""
+        self._pending.append((env, ctx))
         if len(self._pending) >= self.flush_threshold:
             self.flush()
 
@@ -158,12 +163,13 @@ class ValidationPipeline:
         would be verified (and its ``on_verdict`` fired) twice.
         """
         dropped, self._pending = self._pending, []
-        return dropped
+        return [e for e, _ in dropped]
 
     def flush(self) -> List[Tuple[Envelope, bool]]:
         if not self._pending:
             return []
-        batch, self._pending = self._pending, []
+        pairs, self._pending = self._pending, []
+        batch = [e for e, _ in pairs]
         # Structural screen BEFORE the backend call: a truncated/oversized key
         # or signature (attacker-crafted wire bytes) gets a False verdict —
         # it must not raise out of the batched backends and drop everyone
@@ -186,7 +192,7 @@ class ValidationPipeline:
             # Backend infrastructure failure (e.g. native build unavailable):
             # re-queue the batch so no envelope silently loses its verdict,
             # then propagate so the caller can pick another backend.
-            self._pending = batch + self._pending
+            self._pending = pairs + self._pending
             raise
         oks_good = iter(verdicts)
         oks = np.array(
@@ -199,6 +205,9 @@ class ValidationPipeline:
         if self.on_verdict is not None:
             for env, ok in out:
                 self.on_verdict(env, ok)
+        if self.on_verdict_ctx is not None:
+            for (env, ctx), ok in zip(pairs, (bool(o) for o in oks)):
+                self.on_verdict_ctx(env, ok, ctx)
         return out
 
 

@@ -708,3 +708,23 @@ class MultiTopicGossipSub:
         p50 = jnp.nanmedian(flat, axis=1)
         p99 = jnp.nanpercentile(flat, 99.0, axis=1)
         return frac, p50, p99
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def stream_digest(self, st: MultiTopicState):
+        """Per-slot completion counters for the streaming engine.
+
+        One small device_get per chunk: the engine compares
+        ``delivered[topic, slot]`` against its completion threshold to close
+        out pending messages, so ingest→delivery latency comes from host
+        clocks rather than a modeled round count.
+        """
+        topic_alive = self._topic_alive(st)           # [T, N]
+        have = self.have_bool(st)                     # [T, N, M]
+        return {
+            "delivered": (have & topic_alive[:, :, None]).sum(axis=1),  # [T, M]
+            "participants": topic_alive.sum(axis=1),                    # [T]
+            "msg_used": st.msg_used,
+            "msg_valid": st.msg_valid,
+            "msg_birth": st.msg_birth,
+            "step": st.step,
+        }

@@ -4,18 +4,31 @@
 - :mod:`.compiler` — lowering to ``ops.schedule`` event tensors.
 - :mod:`.runner` — execution, traces, bit-for-bit replay.
 - :mod:`.live_runner` — the same campaigns over real sockets + chaos.
+- :mod:`.streaming_runner` — campaigns as open streams through the
+  serving plane's ingest ring + resident engine.
 - :mod:`.slo` — verdicts graded from the flight record.
 - :mod:`.canon` — the named, committed campaign suite.
 """
 
 from .canon import CANON, build, build_all
-from .compiler import CompiledScenario, compile_scenario
+from .compiler import (
+    CompiledScenario,
+    StreamingPlan,
+    compile_scenario,
+    compile_streaming_plan,
+)
 from .live_runner import (
     LivePlaneError,
     LiveScenarioResult,
     live_supported,
     run_live_scenario,
     sim_supported,
+)
+from .streaming_runner import (
+    StreamingPlaneError,
+    StreamingScenarioResult,
+    run_streaming_scenario,
+    streaming_supported,
 )
 from .runner import (
     ScenarioResult,
@@ -47,18 +60,24 @@ __all__ = [
     "SLO",
     "ScenarioResult",
     "ScenarioSpec",
+    "StreamingPlan",
+    "StreamingPlaneError",
+    "StreamingScenarioResult",
     "Verdict",
     "Workload",
     "build",
     "build_all",
     "compile_scenario",
+    "compile_streaming_plan",
     "evaluate",
     "live_supported",
     "replay_trace",
     "run_live_scenario",
     "run_scenario",
+    "run_streaming_scenario",
     "run_suite",
     "save_trace",
     "sim_supported",
+    "streaming_supported",
     "trace_document",
 ]

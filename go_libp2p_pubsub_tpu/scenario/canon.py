@@ -295,6 +295,79 @@ def live_partition_heal() -> ScenarioSpec:
     )
 
 
+# One shared model dict for the streaming pair: the resident engine's jit
+# cache keys on the model's value semantics, so equal configs let the
+# second scenario reuse the first one's compiled chunk.
+_STREAM_MESH = dict(n_topics=2, n_peers=64, n_slots=16, conn_degree=8,
+                    msg_window=64, heartbeat_steps=4)
+
+
+def streaming_steady() -> ScenarioSpec:
+    """STREAMING-ONLY: constant two-topic load through the serving plane's
+    ingest ring into the resident engine under the ``block`` policy.  The
+    conservation SLO is the point: zero silent drops — every accepted
+    message is delivered, queued, or attributed to a named counter — while
+    the queue stays shallow and exact ingest→delivery latency (host clocks,
+    quantized to chunk boundaries) stays bounded."""
+    return ScenarioSpec(
+        name="streaming_steady",
+        family="multitopic",
+        n_steps=32,
+        seed=59,
+        model=dict(_STREAM_MESH),
+        workloads=[
+            Workload(kind="constant", topic=0, start=0, stop=32, every=2),
+            Workload(kind="constant", topic=1, start=1, stop=32, every=2),
+        ],
+        streaming={
+            "streaming_only": True,
+            "chunk_steps": 8,
+            "capacity": 16,
+            "policy": "block",
+        },
+        slo=SLO(
+            min_delivery_frac=0.97,
+            max_queue_depth=16,
+            max_ingest_latency_s=30.0,   # generous: CPU chunks, not rounds
+            max_silent_drops=0,
+        ),
+        description="Two-topic constant stream, block backpressure, zero "
+                    "silent drops.",
+    )
+
+
+def streaming_burst_overload() -> ScenarioSpec:
+    """STREAMING-ONLY: a flash crowd bigger than the ring under
+    ``drop_oldest`` — overload is the SCENARIO.  The ring must shed load
+    through its named eviction counter only (silent_drops stays 0), depth
+    must never exceed capacity, and whatever actually reached the device
+    must still deliver."""
+    return ScenarioSpec(
+        name="streaming_burst_overload",
+        family="multitopic",
+        n_steps=32,
+        seed=61,
+        model=dict(_STREAM_MESH),
+        workloads=[
+            Workload(kind="burst", topic=0, start=0, n_msgs=24),
+            Workload(kind="constant", topic=1, start=2, stop=26, every=4),
+        ],
+        streaming={
+            "streaming_only": True,
+            "chunk_steps": 8,
+            "capacity": 12,
+            "policy": "drop_oldest",
+        },
+        slo=SLO(
+            min_delivery_frac=0.95,
+            max_queue_depth=12,
+            max_silent_drops=0,
+        ),
+        description="24-message burst into a 12-deep ring; shed load is "
+                    "counted eviction, never silent.",
+    )
+
+
 CANON: Dict[str, Callable[[], ScenarioSpec]] = {
     "steady_state": steady_state,
     "flash_crowd": flash_crowd,
@@ -309,6 +382,8 @@ CANON: Dict[str, Callable[[], ScenarioSpec]] = {
     "multitopic_hot_publisher": multitopic_hot_publisher,
     "root_kill_failover": root_kill_failover,
     "live_partition_heal": live_partition_heal,
+    "streaming_steady": streaming_steady,
+    "streaming_burst_overload": streaming_burst_overload,
 }
 
 

@@ -518,3 +518,85 @@ def _compile_tree(spec: ScenarioSpec) -> CompiledScenario:
         spec=spec, model=model, state=st, events=events,
         attackers=None, target=None, n_publishes=n_publishes,
     )
+
+
+# ---------------------------------------------------------------------------
+# streaming lowering (serving plane)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class StreamingPlan:
+    """A spec lowered for the serving plane: a host publish TIMELINE, not
+    device event tensors.  The streaming runner replays ``timeline`` through
+    the ingest ring into a resident :class:`~..serve.engine.StreamingEngine`;
+    the device-side shapes are fixed by (chunk_steps, pub_width), never by
+    the campaign length, which is what lets the stream be unbounded."""
+
+    spec: ScenarioSpec
+    timeline: List[List[tuple]]   # per step: [(topic, src, valid), ...]
+    n_publishes: int
+    chunk_steps: int
+    capacity: int
+    policy: str
+    pub_width: int
+    completion_frac: float
+
+
+def compile_streaming_plan(spec: ScenarioSpec) -> StreamingPlan:
+    """Lower ``spec`` for the streaming plane.
+
+    Honest support matrix: only the ``multitopic`` family has a resident
+    engine, and the serving plane lowers WORKLOADS only — churn, attack and
+    link windows mutate device event tensors mid-scan, which the fixed-shape
+    resident chunk deliberately does not carry (publishes are the only
+    per-chunk variable).  Requesting them raises rather than silently
+    ignoring campaign components.
+    """
+    if spec.family != "multitopic":
+        raise ValueError(
+            f"streaming plane requires the multitopic family, "
+            f"got {spec.family!r}"
+        )
+    if spec.churn or spec.attacks or spec.links or spec.faults:
+        raise ValueError(
+            "churn/attack/link/fault components are not lowered for the "
+            "streaming plane (publishes are the only per-chunk variable)"
+        )
+    T = spec.n_steps
+    n = int(spec.model.get("n_peers", 1024))
+    n_topics = int(spec.model.get("n_topics", 4))
+    cfg = dict(spec.streaming or {})
+    chunk_steps = int(cfg.get("chunk_steps", 8))
+    capacity = int(cfg.get("capacity", 64))
+    policy = str(cfg.get("policy", "block"))
+    # Default pub_width lets ONE chunk drain a full ring: ceil(cap / steps).
+    pub_width = int(cfg.get("pub_width", max(1, -(-capacity // chunk_steps))))
+    completion_frac = float(cfg.get("completion_frac", 0.99))
+
+    timeline: List[List[tuple]] = [[] for _ in range(T)]
+    for wi, w in enumerate(spec.workloads):
+        start, stop = _window(w.start, w.stop, T)
+        rng = _rng(spec.seed, _TAG_WORKLOAD, wi)
+        if not (0 <= w.topic < n_topics):
+            raise ValueError(f"topic {w.topic} out of range [0, {n_topics})")
+        steps = [start] if w.kind == "burst" else range(start, stop, w.every)
+        for t in steps:
+            for _ in range(w.n_msgs):
+                # No churn on this plane, so every peer is alive: publishers
+                # draw uniformly (same per-workload substream discipline as
+                # the sim compiler, so seeds reproduce bit-for-bit).
+                src = int(rng.integers(n)) if w.src is None else w.src
+                if not (0 <= src < n):
+                    raise ValueError(f"publisher {src} out of range [0, {n})")
+                timeline[t].append((w.topic, src, bool(w.valid)))
+
+    return StreamingPlan(
+        spec=spec,
+        timeline=timeline,
+        n_publishes=sum(len(r) for r in timeline),
+        chunk_steps=chunk_steps,
+        capacity=capacity,
+        policy=policy,
+        pub_width=pub_width,
+        completion_frac=completion_frac,
+    )

@@ -89,9 +89,11 @@ def live_supported(spec: ScenarioSpec) -> bool:
 
 def sim_supported(spec: ScenarioSpec) -> bool:
     """Can this spec be lowered onto the sim plane?  Live-only scenarios
-    (root failover, socket-level partition heal) have no device lowering —
-    the mirror image of :func:`live_supported`."""
-    return not spec.live_only
+    (root failover, socket-level partition heal) and streaming-only
+    scenarios (unbounded ingest through the resident serving engine) have
+    no closed-scan device lowering — the mirror image of
+    :func:`live_supported` / ``streaming_runner.streaming_supported``."""
+    return not spec.live_only and not spec.streaming_only
 
 
 def _reject_unsupported(spec: ScenarioSpec) -> None:

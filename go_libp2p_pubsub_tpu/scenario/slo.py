@@ -179,6 +179,35 @@ def evaluate(
             )[-1],
         ))
 
+    # Streaming criteria (serving plane, scenario.streaming_runner).  Same
+    # contract as failover: asking for a channel the runner didn't emit is
+    # a misconfigured scenario, not a vacuous pass.
+    def _streaming_channel(key: str, slo_name: str) -> np.ndarray:
+        if not have(key):
+            raise ValueError(
+                f"{slo_name} SLO needs the {key!r} record channel "
+                "(emitted by the streaming runner's serving scenarios)"
+            )
+        return record[key]
+
+    if slo.max_queue_depth is not None:
+        crits.append(_crit(
+            "queue_depth_peak", "max", slo.max_queue_depth,
+            _streaming_channel("queue_depth_peak", "max_queue_depth")[-1],
+        ))
+    if slo.max_ingest_latency_s is not None:
+        crits.append(_crit(
+            "ingest_lat_max_s", "max", slo.max_ingest_latency_s,
+            _streaming_channel(
+                "ingest_lat_max_s", "max_ingest_latency_s"
+            )[-1],
+        ))
+    if slo.max_silent_drops is not None:
+        crits.append(_crit(
+            "silent_drops", "max", slo.max_silent_drops,
+            _streaming_channel("silent_drops", "max_silent_drops")[-1],
+        ))
+
     return Verdict(
         scenario=spec.name,
         passed=all(c.passed for c in crits),

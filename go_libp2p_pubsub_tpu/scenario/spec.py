@@ -182,6 +182,17 @@ class SLO:
     min_final_epoch: Optional[int] = None
     max_epoch_spread: Optional[int] = None
     max_duplicate_deliveries: Optional[int] = None
+    # Streaming criteria (serving plane, scenario.streaming_runner): graded
+    # from the ``queue_depth_peak`` / ``ingest_lat_max_s`` / ``silent_drops``
+    # record channels.  ``max_queue_depth`` bounds ingest backlog under the
+    # offered load; ``max_ingest_latency_s`` bounds worst-case exact
+    # ingest→delivery (host seconds, quantized to chunk boundaries);
+    # ``max_silent_drops`` is the conservation bound — every message must be
+    # delivered, queued, or attributed to a named backpressure counter
+    # (0 under ``block`` means the ring NEVER loses a message it accepted).
+    max_queue_depth: Optional[int] = None
+    max_ingest_latency_s: Optional[float] = None
+    max_silent_drops: Optional[int] = None
 
 
 @dataclass
@@ -206,6 +217,12 @@ class ScenarioSpec:
     # defaults — keeping this a plain optional dict preserves the exact
     # JSON round-trip for specs that never touch the live plane.
     live: Optional[Dict[str, Any]] = None
+    # Streaming-plane config for scenario.streaming_runner (ignored by the
+    # sim compiler): {"streaming_only": bool, "chunk_steps": int,
+    # "capacity": int, "policy": str, "pub_width": int,
+    # "completion_frac": float}.  Same plain-dict shape as ``live`` so the
+    # JSON round-trip stays exact for specs that never stream.
+    streaming: Optional[Dict[str, Any]] = None
     slo: SLO = field(default_factory=SLO)
     description: str = ""
 
@@ -222,6 +239,13 @@ class ScenarioSpec:
         no sim lowering.  Marked via ``live={"live_only": True, ...}`` so
         the JSON round-trip stays exact."""
         return bool((self.live or {}).get("live_only"))
+
+    @property
+    def streaming_only(self) -> bool:
+        """True when the scenario is a serving-plane campaign (unbounded
+        ingest through the ring into the resident engine) with no closed-sim
+        lowering.  Marked via ``streaming={"streaming_only": True, ...}``."""
+        return bool((self.streaming or {}).get("streaming_only"))
 
     # -- FaultPlan bridge ---------------------------------------------------
 

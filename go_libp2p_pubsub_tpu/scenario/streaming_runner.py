@@ -1,0 +1,194 @@
+"""Streaming-plane scenario execution: spec timeline → ring → resident engine.
+
+The sim plane lowers a campaign to event tensors and runs ONE scan; the
+streaming plane replays the same declarative workloads as an *open* stream:
+each timeline step's publishes are signed, batch-verified by the
+:class:`~..crypto.pipeline.ValidationPipeline` (the crypto stage sits ahead
+of enqueue, so a forged message enters the ring already marked invalid and
+is asserted non-delivered on device), pushed through the
+:class:`~..serve.ingest.IngestRing` under the spec's backpressure policy,
+and drained by a resident :class:`~..serve.engine.StreamingEngine` whose
+compiled chunk never changes shape.
+
+The record it grades is host truth, not device telemetry: queue-depth
+series from the ring, exact ingest→delivery latencies from the engine's
+host clocks (quantized to chunk boundaries — see ``serve.engine``), and
+the ring's conservation ledger (``silent_drops`` must be 0 under every
+policy).  ``slo.evaluate`` reads these through the streaming SLO channels.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import slo as slo_mod
+from .compiler import StreamingPlan, build_model, compile_streaming_plan
+from .spec import ScenarioSpec
+
+
+class StreamingPlaneError(RuntimeError):
+    """The streaming plane failed to COME UP for a scenario (model build,
+    engine warmup).  ``tools/scenario_run.py`` maps this to exit 2 — an
+    infrastructure failure, distinct from a red verdict (exit 1)."""
+
+
+def streaming_supported(spec: ScenarioSpec) -> bool:
+    """Can this spec run on the streaming plane?  It needs the resident
+    multitopic engine and an explicit ``streaming`` config block."""
+    return (
+        spec.streaming is not None
+        and spec.family == "multitopic"
+        and not spec.churn
+        and not spec.attacks
+        and not spec.links
+        and not spec.faults
+    )
+
+
+@dataclasses.dataclass
+class StreamingScenarioResult:
+    """One streaming campaign: plan + verdict + host-truth record."""
+
+    spec: ScenarioSpec
+    plan: StreamingPlan
+    record: Dict[str, np.ndarray]
+    verdict: "slo_mod.Verdict"
+    n_publishes: int
+    accounting: Dict[str, int]
+    engine_stats: Dict[str, Any]
+    seconds: float = 0.0
+
+
+def run_streaming_scenario(
+    spec: ScenarioSpec,
+    max_drain_chunks: int = 64,
+    signer_backend: str = "auto",
+) -> StreamingScenarioResult:
+    """Execute ``spec`` on the streaming plane and grade its SLOs."""
+    from ..crypto import native
+    from ..crypto.pipeline import ValidationPipeline, sign_envelope
+    from ..serve import IngestRing, StreamingEngine
+
+    t0 = time.monotonic()
+    plan = compile_streaming_plan(spec)
+    try:
+        model = build_model(spec)
+    except Exception as e:  # model kwargs are spec data, not code
+        raise StreamingPlaneError(f"model build failed: {e}") from e
+
+    ring = IngestRing(capacity=plan.capacity, policy=plan.policy)
+    engine = StreamingEngine(
+        model,
+        ring,
+        chunk_steps=plan.chunk_steps,
+        pub_width=plan.pub_width,
+        completion_frac=plan.completion_frac,
+        seed=spec.seed,
+    )
+    try:
+        engine.warmup()
+    except Exception as e:
+        raise StreamingPlaneError(f"engine warmup failed: {e}") from e
+
+    # Crypto stage ahead of enqueue: the verdict callback is the ONLY path
+    # into the ring, so an envelope that fails batch verification is pushed
+    # valid=False and the device's publish gate keeps it out of every mesh.
+    backend = (
+        "native" if (signer_backend == "auto" and native.available())
+        else ("python" if signer_backend == "auto" else signer_backend)
+    )
+    rejected_pushes = 0
+
+    def _admit(env, ok, ctx):
+        nonlocal rejected_pushes
+        topic, src = ctx
+        admitted = ring.push(
+            topic=topic, payload=env.payload, publisher=src,
+            valid=ok, timeout=5.0,
+        )
+        if not admitted:
+            rejected_pushes += 1
+
+    pipe = ValidationPipeline(
+        backend=backend, flush_threshold=4096, on_verdict_ctx=_admit
+    )
+
+    # Replay the timeline in chunk-sized groups: submit that group's
+    # publishes through the crypto stage, flush (which enqueues), run one
+    # resident chunk, sample depth.  Forged workloads (valid=False) are
+    # signed with a key that does NOT match the envelope, so the pipeline —
+    # not the spec bit — produces the False verdict the ring records.
+    seed_bytes = spec.seed.to_bytes(8, "little")
+    depth_series: List[int] = []
+    frac_series: List[float] = []
+    seqno = 0
+    n_valid_published = 0
+    T = spec.n_steps
+    for base in range(0, T, plan.chunk_steps):
+        for t in range(base, min(base + plan.chunk_steps, T)):
+            for topic, src, valid in plan.timeline[t]:
+                env = sign_envelope(
+                    seed_bytes + src.to_bytes(4, "little") + b"\x00" * 20,
+                    f"topic-{topic}", seqno, b"stream-%d" % seqno,
+                    backend="native" if backend == "native" else "python",
+                )
+                if not valid:
+                    env = dataclasses.replace(
+                        env, signature=b"\x00" * 64
+                    )
+                pipe.submit(env, ctx=(topic, src))
+                seqno += 1
+                if valid:
+                    n_valid_published += 1
+        pipe.flush()
+        depth_series.append(ring.depth)
+        engine.run_chunk()
+        frac_series.append(
+            engine.completed / max(1, len(engine.publish_log))
+        )
+
+    engine.run_until_drained(max_chunks=max_drain_chunks)
+    acct = ring.accounting()
+    lats = engine.latencies_s
+    q = engine.latency_quantiles()
+
+    # Host-truth flight record, shaped like the other planes' (leading time
+    # axis, scalars as length-1 series) so slo.evaluate reads uniformly.
+    delivery_frac = engine.completed / max(1, len(engine.publish_log))
+    record: Dict[str, np.ndarray] = {
+        "queue_depth": np.asarray(depth_series, np.int64),
+        "queue_depth_peak": np.asarray([acct["max_depth"]], np.int64),
+        "ingest_lat_p50_s": np.asarray([q["p50"]], np.float64),
+        "ingest_lat_p99_s": np.asarray([q["p99"]], np.float64),
+        "ingest_lat_max_s": np.asarray(
+            [max(lats) if lats else float("nan")], np.float64
+        ),
+        "silent_drops": np.asarray([acct["silent_drops"]], np.int64),
+        "delivery_frac": np.asarray(
+            frac_series + [delivery_frac], np.float64
+        ),
+    }
+    verdict = slo_mod.evaluate(spec, record, plan.n_publishes)
+    return StreamingScenarioResult(
+        spec=spec,
+        plan=plan,
+        record=record,
+        verdict=verdict,
+        n_publishes=plan.n_publishes,
+        accounting=acct,
+        engine_stats={
+            "chunks_run": engine.chunks_run,
+            "compile_cache_size": engine.compile_cache_size(),
+            "published": engine.published,
+            "completed": engine.completed,
+            "evicted": engine.evicted,
+            "valid_published": n_valid_published,
+            "rejected_pushes": rejected_pushes,
+            "pipeline": dict(pipe.stats),
+        },
+        seconds=time.monotonic() - t0,
+    )

@@ -1,0 +1,20 @@
+"""Streaming serving plane: resident rollout fed by a host ingest ring.
+
+The closed-loop bench replays a fixed signed window inside one scan; this
+package is the serving shape the BASELINE north star actually describes —
+an unbounded publish stream flowing through a host-side ring buffer
+(:mod:`.ingest`) into a device-resident chunked rollout (:mod:`.engine`)
+whose compiled program never changes shape, so the stream rides one XLA
+compilation for its whole lifetime.
+"""
+
+from .engine import PendingMessage, StreamingEngine
+from .ingest import BACKPRESSURE_POLICIES, IngestItem, IngestRing
+
+__all__ = [
+    "BACKPRESSURE_POLICIES",
+    "IngestItem",
+    "IngestRing",
+    "PendingMessage",
+    "StreamingEngine",
+]

@@ -1,0 +1,221 @@
+"""Device-resident streaming rollout: one compiled chunk, replayed forever.
+
+The scenario engine's trick (``ops/schedule.py``) was to make a whole
+campaign the ``xs`` of one ``lax.scan``; the streaming engine turns that
+inside out — the *shapes* of the event tensors are frozen once
+(``chunk_steps`` scan rows x ``pub_width`` publish slots, padded with the
+schedule's ``-1`` sentinels and gated by the model's ``lax.cond``
+publishes) and every chunk replays the SAME compiled program on freshly
+filled tensors.  GossipSub state flows chunk-to-chunk through donated
+buffers, so an unbounded publish stream rides one XLA compilation with no
+per-chunk allocation of the resident state.
+
+Latency is exact, not modeled: each message carries the host-clock
+timestamp its :class:`~.ingest.IngestRing` ``push`` stamped, and the engine
+reports ingest→delivery as host seconds from that stamp to the end of the
+chunk in which the message's delivered count crossed the completion
+threshold.  The quantization this implies (delivery is observed at chunk
+boundaries, so latencies are rounded UP to the next boundary) is a
+documented property of the measurement, not an approximation inside it.
+
+The flight-recorder tail (the last round of every in-scan telemetry
+channel, including the latency histogram) is carried across chunks so a
+scrape mid-stream sees current telemetry without any extra device work.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Tuple
+
+import jax
+import numpy as np
+
+from ..models.multitopic import MultiTopicGossipSub
+from ..ops import schedule as sched
+from .ingest import IngestItem, IngestRing
+
+
+@dataclasses.dataclass
+class PendingMessage:
+    """A published message awaiting its completion threshold."""
+
+    seq: int
+    topic: int
+    slot: int
+    publisher: int
+    t_ingest: float       # host clock at ring push
+    t_publish: float      # host clock when its chunk was dispatched
+    step_published: int   # global device step of its publish row
+
+
+class StreamingEngine:
+    """Resident chunked rollout over a :class:`MultiTopicGossipSub`.
+
+    ``run_chunk`` pops up to ``chunk_steps * pub_width`` ring items, packs
+    them into a fixed-shape ``MultiTopicEvents`` (publishes spread
+    round-robin over the chunk's rows), and invokes the donated-buffer
+    compiled rollout.  ``compile_cache_size()`` must stay 1 after warmup —
+    the no-recompilation contract the tests assert.
+    """
+
+    def __init__(
+        self,
+        model: MultiTopicGossipSub,
+        ring: IngestRing,
+        chunk_steps: int = 8,
+        pub_width: int = 4,
+        completion_frac: float = 0.99,
+        seed: int = 0,
+        metrics=None,
+        clock=time.monotonic,
+    ) -> None:
+        if chunk_steps < 1 or pub_width < 1:
+            raise ValueError("chunk_steps and pub_width must be >= 1")
+        if not (0.0 < completion_frac <= 1.0):
+            raise ValueError("completion_frac must be in (0, 1]")
+        self.model = model
+        self.ring = ring
+        self.chunk_steps = chunk_steps
+        self.pub_width = pub_width
+        self.completion_frac = completion_frac
+        self.metrics = metrics
+        self._clock = clock
+        self.state = model.init(seed=seed)
+        # The resident program: donated state in, fixed event shapes.  The
+        # inner rollout_events jit is keyed on the model's value semantics,
+        # so engines over equal configs share both cache layers.
+        self._rollout = jax.jit(
+            lambda st, ev: model.rollout_events(st, ev, record=True),
+            donate_argnums=(0,),
+        )
+        self._next_slot = [0] * model.t          # per-topic cyclic allocator
+        self.pending: Dict[Tuple[int, int], PendingMessage] = {}
+        self.latencies_s: List[float] = []       # completed, host seconds
+        self.publish_log: List[PendingMessage] = []   # every VALID publish
+        self.invalid_published: List[Tuple[int, int]] = []  # (topic, slot)
+        self.chunks_run = 0
+        self.published = 0
+        self.completed = 0
+        self.evicted = 0       # window slot recycled before completion
+        self.flight_tail: Dict[str, np.ndarray] = {}
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def warmup(self) -> None:
+        """Run one all-quiet chunk to pay the compile before traffic
+        arrives (the serving analog of the bench's compile+warm pass).
+        Advances the device state by ``chunk_steps`` idle rounds."""
+        self._dispatch(self._empty_events())
+
+    def compile_cache_size(self) -> int:
+        """Number of compiled variants of the resident chunk — 1 after
+        warmup, and STILL 1 after any number of chunks, or shapes drifted."""
+        return self._rollout._cache_size()
+
+    # -- the chunk loop -----------------------------------------------------
+
+    def run_chunk(self) -> dict:
+        """Pop one chunk's worth of ingest, publish, advance chunk_steps
+        rounds, and fold completions.  Returns a host-side summary."""
+        events = self._empty_events()
+        items = self.ring.pop_batch(self.chunk_steps * self.pub_width)
+        base_step = self.chunks_run * self.chunk_steps
+        t_dispatch = self._clock()
+        for i, item in enumerate(items):
+            row = i % self.chunk_steps
+            col = i // self.chunk_steps
+            slot = self._alloc_slot(item)
+            events.pub_topic[row, col] = item.topic
+            events.pub_src[row, col] = item.publisher
+            events.pub_slot[row, col] = slot
+            events.pub_valid[row, col] = item.valid
+            if item.valid:
+                p = PendingMessage(
+                    seq=item.seq, topic=item.topic, slot=slot,
+                    publisher=item.publisher, t_ingest=item.t_ingest,
+                    t_publish=t_dispatch, step_published=base_step + row,
+                )
+                self.pending[(item.topic, slot)] = p
+                self.publish_log.append(p)
+            else:
+                self.invalid_published.append((item.topic, slot))
+            self.published += 1
+        return self._dispatch(events, n_items=len(items))
+
+    def run_until_drained(self, max_chunks: int = 64) -> int:
+        """Chunk until the ring is empty and no message is pending (or the
+        chunk budget runs out).  Returns chunks run by this call."""
+        n = 0
+        while n < max_chunks and (self.ring.depth > 0 or self.pending):
+            self.run_chunk()
+            n += 1
+        return n
+
+    # -- views --------------------------------------------------------------
+
+    def latency_quantiles(self, qs=(0.5, 0.99)) -> Dict[str, float]:
+        """{"p50": ..., "p99": ...} over completed ingest→delivery
+        latencies (host seconds); NaN when nothing completed yet."""
+        from ..utils.metrics import quantiles
+
+        return quantiles(self.latencies_s, qs)
+
+    # -- internals ----------------------------------------------------------
+
+    def _empty_events(self) -> sched.MultiTopicEvents:
+        return sched.empty_multitopic_events(
+            self.chunk_steps, self.model.n, self.pub_width
+        )
+
+    def _alloc_slot(self, item: IngestItem) -> int:
+        slot = self._next_slot[item.topic]
+        self._next_slot[item.topic] = (slot + 1) % self.model.m
+        stale = self.pending.pop((item.topic, slot), None)
+        if stale is not None:
+            # Window recycle outran delivery tracking: the old message is
+            # closed out as evicted (counted, never silently lost).
+            self.evicted += 1
+            if self.metrics is not None:
+                self.metrics.inc("serve.engine.evicted")
+        return slot
+
+    def _dispatch(self, events: sched.MultiTopicEvents, n_items: int = 0):
+        self.state, record = self._rollout(self.state, events)
+        digest = jax.device_get(self.model.stream_digest(self.state))
+        t_done = self._clock()
+        self.chunks_run += 1
+        completed_now = self._fold_completions(digest, t_done)
+        # Flight-recorder tail: the final round of each telemetry channel
+        # (one device_get; lat_hist's last row is the window-cumulative
+        # histogram at the chunk boundary).
+        host_rec = jax.device_get(record)
+        self.flight_tail = {
+            k: np.asarray(v)[-1] for k, v in host_rec.items()
+        }
+        if self.metrics is not None:
+            self.metrics.gauge("serve.engine.pending", len(self.pending))
+            self.metrics.inc("serve.engine.chunks")
+        return {
+            "chunk": self.chunks_run - 1,
+            "items": n_items,
+            "completed_now": completed_now,
+            "pending": len(self.pending),
+            "step": int(digest["step"]),
+        }
+
+    def _fold_completions(self, digest: dict, t_done: float) -> int:
+        delivered = np.asarray(digest["delivered"])        # [T, M]
+        participants = np.asarray(digest["participants"])  # [T]
+        done = 0
+        for (topic, slot), p in list(self.pending.items()):
+            target = max(1, int(self.completion_frac * participants[topic]))
+            if int(delivered[topic, slot]) >= target:
+                self.latencies_s.append(t_done - p.t_ingest)
+                self.completed += 1
+                del self.pending[(topic, slot)]
+                done += 1
+        if done and self.metrics is not None:
+            self.metrics.inc("serve.engine.completed", done)
+        return done

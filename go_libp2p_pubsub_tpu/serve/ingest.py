@@ -1,0 +1,205 @@
+"""Host ingest ring: batch an unbounded publish stream into device chunks.
+
+Producers (socket handlers, the bench's load generators, the scenario
+streaming runner) ``push`` (topic, payload, publisher) tuples; the
+:class:`~.engine.StreamingEngine` ``pop_batch``-es them into the fixed-shape
+publish slots of its next rollout chunk.  The ring is a preallocated
+circular buffer under one lock — "lock-free-ish" in the honest sense that
+the hot path is a couple of index updates inside an uncontended mutex, not
+a CAS loop; the contention profile that matters here is one producer-side
+caller vs one consumer-side engine thread.
+
+Backpressure is an explicit, named policy — never an implicit drop:
+
+- ``block``       — ``push`` waits (bounded by ``timeout``) for space; a
+                    timed-out push returns ``False`` to ITS caller, so no
+                    message ever vanishes unacknowledged;
+- ``drop_oldest`` — the ring evicts its head to admit the newcomer
+                    (freshest-wins streams), counting every eviction;
+- ``reject``      — a full ring refuses the newcomer (caller retries).
+
+``accounting()`` exposes the conservation check the streaming SLO grades:
+every accepted message is either still queued, handed to the device, or
+attributed to a named policy counter — ``silent_drops`` is the residual and
+must be zero under every policy.
+
+Queue-depth and policy counters land on an (optional) existing
+:class:`~..utils.metrics.MetricsRegistry` under ``serve.ingest.*``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import List, Optional
+
+BACKPRESSURE_POLICIES = ("block", "drop_oldest", "reject")
+
+
+@dataclass(frozen=True)
+class IngestItem:
+    """One queued publish: identity, payload, and its ingest timestamp
+    (host clock at ``push`` — the start of the ingest→delivery latency the
+    engine measures exactly)."""
+
+    seq: int            # ring-assigned, monotonically increasing
+    topic: int
+    publisher: int
+    payload: bytes
+    valid: bool         # upstream validation verdict (gates relay on device)
+    t_ingest: float     # host clock at push
+
+
+class IngestRing:
+    """Bounded FIFO ring of :class:`IngestItem` with explicit backpressure.
+
+    Thread-safe; ``push`` and ``pop_batch`` may run from different threads.
+    Zero-length payloads are legal (a bare topic beacon is a real pubsub
+    message shape).
+    """
+
+    def __init__(
+        self,
+        capacity: int,
+        policy: str = "block",
+        metrics=None,
+        clock=time.monotonic,
+    ) -> None:
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        if policy not in BACKPRESSURE_POLICIES:
+            raise ValueError(
+                f"unknown backpressure policy {policy!r}; "
+                f"have: {', '.join(BACKPRESSURE_POLICIES)}"
+            )
+        self.capacity = capacity
+        self.policy = policy
+        self.metrics = metrics
+        self._clock = clock
+        self._buf: List[Optional[IngestItem]] = [None] * capacity
+        self._head = 0          # index of the oldest item
+        self._size = 0
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._not_full = threading.Condition(self._lock)
+        self.max_depth = 0
+        self._accepted = 0
+        self._popped = 0
+        self._dropped_oldest = 0
+        self._rejected = 0
+        self._block_waits = 0
+
+    # -- producer side ------------------------------------------------------
+
+    def push(
+        self,
+        topic: int,
+        payload: bytes,
+        publisher: int,
+        valid: bool = True,
+        timeout: Optional[float] = None,
+    ) -> bool:
+        """Enqueue one publish; returns True iff it was admitted.
+
+        ``timeout`` only applies under the ``block`` policy (None = wait
+        forever).  A False return means the CALLER still owns the message —
+        the ring never took it, so nothing was dropped silently.
+        """
+        with self._lock:
+            if self._size >= self.capacity:
+                if self.policy == "reject":
+                    self._rejected += 1
+                    self._metric_inc("serve.ingest.rejected")
+                    return False
+                if self.policy == "drop_oldest":
+                    self._evict_oldest_locked()
+                else:  # block
+                    self._block_waits += 1
+                    self._metric_inc("serve.ingest.block_waits")
+                    if not self._not_full.wait_for(
+                        lambda: self._size < self.capacity, timeout=timeout
+                    ):
+                        self._rejected += 1
+                        self._metric_inc("serve.ingest.rejected")
+                        return False
+            item = IngestItem(
+                seq=self._seq,
+                topic=int(topic),
+                publisher=int(publisher),
+                payload=bytes(payload),
+                valid=bool(valid),
+                t_ingest=self._clock(),
+            )
+            self._seq += 1
+            self._buf[(self._head + self._size) % self.capacity] = item
+            self._size += 1
+            self._accepted += 1
+            self.max_depth = max(self.max_depth, self._size)
+            self._metric_inc("serve.ingest.accepted")
+            self._metric_depth()
+            return True
+
+    # -- consumer side ------------------------------------------------------
+
+    def pop_batch(self, max_n: int) -> List[IngestItem]:
+        """Dequeue up to ``max_n`` items in FIFO order (may be empty)."""
+        out: List[IngestItem] = []
+        with self._lock:
+            take = min(max_n, self._size)
+            for _ in range(take):
+                item = self._buf[self._head]
+                assert item is not None
+                self._buf[self._head] = None
+                self._head = (self._head + 1) % self.capacity
+                self._size -= 1
+                out.append(item)
+            self._popped += len(out)
+            if out:
+                self._not_full.notify_all()
+                self._metric_depth()
+        return out
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def depth(self) -> int:
+        with self._lock:
+            return self._size
+
+    def accounting(self) -> dict:
+        """Conservation ledger.  ``silent_drops`` is the residual between
+        what was accepted and what is accounted for — the streaming SLO's
+        zero-silent-drops channel reads it directly."""
+        with self._lock:
+            silent = (
+                self._accepted - self._popped - self._dropped_oldest
+                - self._size
+            )
+            return {
+                "accepted": self._accepted,
+                "popped": self._popped,
+                "in_queue": self._size,
+                "dropped_oldest": self._dropped_oldest,
+                "rejected": self._rejected,
+                "block_waits": self._block_waits,
+                "max_depth": self.max_depth,
+                "silent_drops": silent,
+            }
+
+    # -- internals ----------------------------------------------------------
+
+    def _evict_oldest_locked(self) -> None:
+        self._buf[self._head] = None
+        self._head = (self._head + 1) % self.capacity
+        self._size -= 1
+        self._dropped_oldest += 1
+        self._metric_inc("serve.ingest.dropped_oldest")
+
+    def _metric_inc(self, name: str) -> None:
+        if self.metrics is not None:
+            self.metrics.inc(name)
+
+    def _metric_depth(self) -> None:
+        if self.metrics is not None:
+            self.metrics.gauge("serve.ingest.depth", self._size)

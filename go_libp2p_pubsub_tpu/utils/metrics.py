@@ -86,6 +86,23 @@ def snapshot(metrics: Dict[str, jax.Array]) -> Dict[str, float]:
     return {k: float(v) for k, v in host.items()}
 
 
+def quantiles(samples, qs: Tuple[float, ...] = (0.5, 0.99)) -> Dict[str, float]:
+    """Host-side exact quantiles over raw samples, keyed ``p50``-style.
+
+    The streaming engine's ingest→delivery latencies are a host list, not a
+    device histogram, so unlike ``flight_summary`` no bucket interpolation
+    is involved.  Empty input yields NaNs (nothing completed yet).
+    """
+    import numpy as _np
+
+    keys = [f"p{round(q * 100, 6):g}" for q in qs]
+    if len(samples) == 0:
+        return {k: float("nan") for k in keys}
+    vals = _np.percentile(_np.asarray(samples, dtype=_np.float64),
+                          [q * 100.0 for q in qs])
+    return {k: float(v) for k, v in zip(keys, vals)}
+
+
 def flight_summary(record: Dict[str, jax.Array]) -> Dict[str, Any]:
     """Host-side digest of a rollout flight record (one ``device_get``).
 
@@ -154,6 +171,12 @@ class MetricsRegistry:
     def latest(self, name: str) -> Optional[float]:
         s = self._series.get(name)
         return s[-1][1] if s else None
+
+    def series_max(self, name: str) -> Optional[float]:
+        """Max value ever recorded on a gauge series (peak queue depth and
+        friends), or None if the series was never written."""
+        s = self._series.get(name)
+        return max(v for _, v in s) if s else None
 
     def export(self) -> str:
         """All counters + latest gauges as one JSON object string."""

@@ -284,16 +284,23 @@ def test_canon_suite_all_green():
 
 
 def test_live_only_canon_flagged_and_filtered():
-    """The two live-only scenarios declare themselves out of the sim plane
-    (and in the live plane); everything else supports sim."""
+    """The live-only and streaming-only scenarios declare themselves out of
+    the sim plane (and into their own); everything else supports sim."""
     for name in ("root_kill_failover", "live_partition_heal"):
         s = scenario.build(name)
         assert s.live_only
         assert not scenario.sim_supported(s)
         assert scenario.live_supported(s)
+    for name in ("streaming_steady", "streaming_burst_overload"):
+        s = scenario.build(name)
+        assert s.streaming_only
+        assert not scenario.sim_supported(s)
+        assert scenario.streaming_supported(s)
+    single_plane = ("root_kill_failover", "live_partition_heal",
+                    "streaming_steady", "streaming_burst_overload")
     assert all(scenario.sim_supported(s)
                for s in scenario.build_all()
-               if s.name not in ("root_kill_failover", "live_partition_heal"))
+               if s.name not in single_plane)
 
 
 def test_slo_failover_criteria():

@@ -1,0 +1,367 @@
+"""Streaming serving plane: ingest ring, resident engine, streaming canon.
+
+The contracts under test, in order of importance:
+
+1. The resident chunk compiles EXACTLY ONCE — warmup plus any number of
+   chunks leaves one entry in the jit cache (fixed event-tensor shapes).
+2. The ring's conservation ledger: every accepted message is delivered,
+   queued, or attributed to a NAMED backpressure counter, under all three
+   policies — ``silent_drops`` is always zero.
+3. Exact latency accounting: ingest timestamps survive chunk boundaries,
+   publish steps are monotone, and completed latencies are real host-clock
+   intervals.
+4. The streaming canon grades green and the plane wiring (scenario_run
+   ``--plane streaming``, ``--list`` labels) holds.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu import scenario
+from go_libp2p_pubsub_tpu.models.multitopic import MultiTopicGossipSub
+from go_libp2p_pubsub_tpu.serve import (
+    BACKPRESSURE_POLICIES,
+    IngestRing,
+    StreamingEngine,
+)
+from go_libp2p_pubsub_tpu.utils.metrics import MetricsRegistry, quantiles
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# ingest ring
+# ---------------------------------------------------------------------------
+
+
+def test_ring_fifo_wraparound():
+    """Pushing/popping past capacity several times keeps FIFO order and
+    monotone seq across the physical wrap of the circular buffer."""
+    ring = IngestRing(capacity=4, policy="reject")
+    seen = []
+    for round_ in range(5):
+        for i in range(3):
+            assert ring.push(topic=0, payload=bytes([round_, i]), publisher=i)
+        items = ring.pop_batch(3)
+        assert [it.payload for it in items] == [
+            bytes([round_, i]) for i in range(3)
+        ]
+        seen.extend(it.seq for it in items)
+    assert seen == sorted(seen) == list(range(15))
+    assert ring.depth == 0
+    assert ring.accounting()["silent_drops"] == 0
+
+
+def test_ring_policy_reject():
+    ring = IngestRing(capacity=2, policy="reject")
+    assert ring.push(topic=0, payload=b"a", publisher=0)
+    assert ring.push(topic=0, payload=b"b", publisher=1)
+    assert not ring.push(topic=0, payload=b"c", publisher=2)
+    acct = ring.accounting()
+    assert acct["rejected"] == 1 and acct["accepted"] == 2
+    assert acct["silent_drops"] == 0
+    # rejected message never entered: FIFO intact
+    assert [i.payload for i in ring.pop_batch(8)] == [b"a", b"b"]
+
+
+def test_ring_policy_drop_oldest():
+    ring = IngestRing(capacity=2, policy="drop_oldest")
+    for p in (b"a", b"b", b"c", b"d"):
+        assert ring.push(topic=0, payload=p, publisher=0)
+    acct = ring.accounting()
+    assert acct["dropped_oldest"] == 2 and acct["accepted"] == 4
+    assert acct["silent_drops"] == 0
+    # freshest-wins: the survivors are the two newest, still in order
+    assert [i.payload for i in ring.pop_batch(8)] == [b"c", b"d"]
+
+
+def test_ring_policy_block_timeout_and_release():
+    ring = IngestRing(capacity=1, policy="block")
+    assert ring.push(topic=0, payload=b"a", publisher=0)
+    # full + nobody draining -> the bounded wait times out, caller keeps
+    # ownership, and the ledger still balances
+    t0 = time.monotonic()
+    assert not ring.push(topic=0, payload=b"b", publisher=0, timeout=0.05)
+    assert time.monotonic() - t0 >= 0.04
+    acct = ring.accounting()
+    assert acct["block_waits"] == 1 and acct["rejected"] == 1
+    assert acct["silent_drops"] == 0
+
+    # a concurrent consumer releases the blocked producer
+    result = {}
+
+    def producer():
+        result["ok"] = ring.push(topic=0, payload=b"c", publisher=1,
+                                 timeout=5.0)
+
+    th = threading.Thread(target=producer)
+    th.start()
+    time.sleep(0.05)
+    assert ring.pop_batch(1)[0].payload == b"a"
+    th.join(timeout=5.0)
+    assert result["ok"] and ring.pop_batch(1)[0].payload == b"c"
+    assert ring.accounting()["silent_drops"] == 0
+
+
+def test_ring_zero_length_payload_and_validation():
+    ring = IngestRing(capacity=2)
+    assert ring.push(topic=1, payload=b"", publisher=5, valid=False)
+    item = ring.pop_batch(1)[0]
+    assert item.payload == b"" and item.topic == 1 and not item.valid
+    with pytest.raises(ValueError, match="capacity"):
+        IngestRing(capacity=0)
+    with pytest.raises(ValueError, match="policy"):
+        IngestRing(capacity=1, policy="yolo")
+    assert set(BACKPRESSURE_POLICIES) == {"block", "drop_oldest", "reject"}
+
+
+def test_ring_metrics_and_depth_gauges():
+    reg = MetricsRegistry()
+    ring = IngestRing(capacity=3, policy="drop_oldest", metrics=reg)
+    for i in range(5):
+        ring.push(topic=0, payload=b"x", publisher=i)
+    ring.pop_batch(3)
+    assert reg.counters()["serve.ingest.accepted"] == 5
+    assert reg.counters()["serve.ingest.dropped_oldest"] == 2
+    assert reg.series_max("serve.ingest.depth") == 3
+    assert reg.latest("serve.ingest.depth") == 0
+    assert ring.max_depth == 3
+
+
+def test_quantiles_helper():
+    q = quantiles([1.0, 2.0, 3.0, 4.0], qs=(0.5, 0.99))
+    assert q["p50"] == 2.5 and 3.9 < q["p99"] <= 4.0
+    assert np.isnan(quantiles([])["p50"])
+
+
+# ---------------------------------------------------------------------------
+# resident engine (one tiny shared model; compile amortized module-wide)
+# ---------------------------------------------------------------------------
+
+_TINY = dict(n_topics=2, n_peers=16, n_slots=8, conn_degree=4,
+             msg_window=16, heartbeat_steps=4)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    return MultiTopicGossipSub(**_TINY)
+
+
+def _engine(model, **kw):
+    ring = IngestRing(capacity=kw.pop("capacity", 32),
+                      policy=kw.pop("policy", "block"))
+    kw.setdefault("chunk_steps", 6)
+    kw.setdefault("pub_width", 2)
+    return StreamingEngine(model, ring, **kw), ring
+
+
+def test_engine_compiles_once_across_chunks(tiny_model):
+    """The no-recompilation contract: warmup pays the compile, then >=3
+    loaded chunks reuse the same cache entry (fixed shapes + donation)."""
+    eng, ring = _engine(tiny_model)
+    eng.warmup()
+    assert eng.compile_cache_size() == 1
+    for c in range(3):
+        for i in range(4):
+            ring.push(topic=i % 2, payload=b"m", publisher=(c + i) % 16)
+        eng.run_chunk()
+        assert eng.compile_cache_size() == 1, f"recompiled at chunk {c}"
+    assert eng.chunks_run == 4  # warmup + 3
+
+
+def test_engine_delivers_and_records_exact_latency(tiny_model):
+    eng, ring = _engine(tiny_model)
+    eng.warmup()
+    for i in range(4):
+        ring.push(topic=i % 2, payload=b"payload", publisher=i)
+    t_push = time.monotonic()
+    eng.run_until_drained(max_chunks=16)
+    t_done = time.monotonic()
+    assert eng.completed == 4 and not eng.pending
+    assert len(eng.latencies_s) == 4
+    # latencies are real host-clock intervals bounded by the drain window
+    for lat in eng.latencies_s:
+        assert 0 < lat <= (t_done - t_push) + 0.1
+    q = eng.latency_quantiles()
+    assert q["p50"] <= q["p99"]
+
+
+def test_engine_timestamps_monotone_across_chunk_boundaries(tiny_model):
+    """Ingest timestamps and publish steps survive chunk boundaries: the
+    publish log is step-monotone, and each message's ingest stamp precedes
+    its publish dispatch."""
+    eng, ring = _engine(tiny_model)
+    eng.warmup()
+    for chunk in range(3):
+        for i in range(3):
+            ring.push(topic=0, payload=b"x", publisher=(chunk * 3 + i) % 16)
+        eng.run_chunk()
+    steps = [p.step_published for p in eng.publish_log]
+    assert steps == sorted(steps)
+    # chunk boundaries: publishes landed in 3 distinct chunks
+    assert len({s // eng.chunk_steps for s in steps}) == 3
+    for p in eng.publish_log:
+        assert p.t_ingest <= p.t_publish
+    seqs = [p.seq for p in eng.publish_log]
+    assert seqs == sorted(seqs)
+
+
+def test_engine_invalid_publish_never_delivers(tiny_model):
+    import jax
+
+    eng, ring = _engine(tiny_model)
+    eng.warmup()
+    ring.push(topic=0, payload=b"good", publisher=1, valid=True)
+    ring.push(topic=0, payload=b"forged", publisher=2, valid=False)
+    eng.run_until_drained(max_chunks=16)
+    assert eng.completed == 1
+    assert len(eng.invalid_published) == 1
+    digest = jax.device_get(tiny_model.stream_digest(eng.state))
+    topic, slot = eng.invalid_published[0]
+    assert int(digest["delivered"][topic, slot]) <= 1
+
+
+def test_engine_rejects_bad_config(tiny_model):
+    ring = IngestRing(capacity=4)
+    with pytest.raises(ValueError):
+        StreamingEngine(tiny_model, ring, chunk_steps=0)
+    with pytest.raises(ValueError):
+        StreamingEngine(tiny_model, ring, completion_frac=0.0)
+
+
+# ---------------------------------------------------------------------------
+# crypto pipeline ctx pass-through
+# ---------------------------------------------------------------------------
+
+
+def test_pipeline_ctx_passthrough():
+    from go_libp2p_pubsub_tpu.crypto.pipeline import (
+        Envelope,
+        ValidationPipeline,
+        sign_envelope,
+    )
+
+    got = []
+    pipe = ValidationPipeline(
+        backend="python", flush_threshold=100,
+        on_verdict_ctx=lambda env, ok, ctx: got.append((env.seqno, ok, ctx)),
+    )
+    good = sign_envelope(b"\x07" * 32, "t", 0, b"ok")
+    bad = Envelope("t", 1, b"x", good.pubkey, b"\x00" * 64)
+    pipe.submit(good, ctx=("route", 3))
+    pipe.submit(bad, ctx=("route", 9))
+    pipe.submit(good, ctx=None)  # ctx is optional
+    pipe.flush()
+    assert got == [(0, True, ("route", 3)), (1, False, ("route", 9)),
+                   (0, True, None)]
+    # drop_pending still hands back bare envelopes
+    pipe.submit(bad, ctx="ctx")
+    assert pipe.drop_pending() == [bad]
+
+
+# ---------------------------------------------------------------------------
+# streaming scenario plane
+# ---------------------------------------------------------------------------
+
+
+def _small_streaming_spec(**kw):
+    streaming = {
+        "streaming_only": True, "chunk_steps": 6, "capacity": 8,
+        "policy": "block",
+    }
+    streaming.update(kw.pop("streaming", {}))
+    return scenario.ScenarioSpec(
+        name="tiny_stream",
+        family="multitopic",
+        n_steps=12,
+        seed=5,
+        model=dict(_TINY),
+        workloads=[scenario.Workload(kind="constant", topic=0, start=0,
+                                     stop=12, every=2)],
+        streaming=streaming,
+        slo=scenario.SLO(min_delivery_frac=0.9, max_queue_depth=8,
+                         max_silent_drops=0),
+        **kw,
+    )
+
+
+def test_streaming_plan_compile_and_support():
+    spec = _small_streaming_spec()
+    assert scenario.streaming_supported(spec)
+    assert not scenario.sim_supported(spec)
+    plan = scenario.compile_streaming_plan(spec)
+    assert plan.n_publishes == 6
+    assert plan.chunk_steps == 6 and plan.capacity == 8
+    # same spec + seed -> bit-identical timeline (substream discipline)
+    plan2 = scenario.compile_streaming_plan(_small_streaming_spec())
+    assert plan2.timeline == plan.timeline
+    # honest support matrix: non-multitopic and campaign components raise
+    with pytest.raises(ValueError, match="multitopic"):
+        scenario.compile_streaming_plan(
+            scenario.ScenarioSpec(name="x", family="gossipsub",
+                                  streaming={"streaming_only": True})
+        )
+    bad = _small_streaming_spec()
+    bad.churn = [scenario.ChurnPhase(start=1, stop=2)]
+    with pytest.raises(ValueError, match="churn"):
+        scenario.compile_streaming_plan(bad)
+
+
+def test_streaming_scenario_runs_and_grades():
+    spec = _small_streaming_spec()
+    res = scenario.run_streaming_scenario(spec)
+    assert res.verdict.passed, str(res.verdict)
+    assert res.engine_stats["compile_cache_size"] == 1
+    assert res.record["silent_drops"][-1] == 0
+    assert res.record["queue_depth"].shape[0] == 2  # 12 steps / 6 per chunk
+    assert np.isfinite(res.record["ingest_lat_p50_s"][-1])
+    assert res.accounting["accepted"] == res.n_publishes == 6
+
+
+def test_slo_streaming_criteria_fail_loudly_without_channels():
+    spec = _small_streaming_spec()
+    with pytest.raises(ValueError, match="queue_depth_peak"):
+        scenario.evaluate(spec, {"delivery_frac": np.ones(1)}, 1)
+
+
+@pytest.mark.slow
+def test_streaming_canon_green():
+    for name in ("streaming_steady", "streaming_burst_overload"):
+        res = scenario.run_streaming_scenario(scenario.build(name))
+        assert res.verdict.passed, str(res.verdict)
+        assert res.engine_stats["compile_cache_size"] == 1
+
+
+# ---------------------------------------------------------------------------
+# tools wiring
+# ---------------------------------------------------------------------------
+
+
+def _run_tool(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "scenario_run.py"),
+         *args],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+
+
+def test_scenario_run_list_labels_streaming_plane():
+    r = _run_tool("--list")
+    assert r.returncode == 0, r.stderr
+    lines = {l.split()[0]: l for l in r.stdout.splitlines() if l.strip()}
+    assert "streaming" in lines["streaming_steady"]
+    assert "streaming" in lines["streaming_burst_overload"]
+    assert "sim" in lines["steady_state"]
+
+
+def test_scenario_run_unknown_plane_exits_nonzero():
+    r = _run_tool("--plane", "bogus", "steady_state")
+    assert r.returncode == 2
+    assert "invalid choice" in r.stderr

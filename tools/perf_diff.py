@@ -80,6 +80,28 @@ SCALAR_ROWS: List[Tuple[Tuple[str, ...], str, bool]] = [
      "eager degraded p99 (rounds)", False),
     (("rlnc", "degraded", "eager_iwant", "delivery_frac"),
      "eager degraded delivery frac", True),
+    # Streaming serving-plane section (r12+); same warn-not-crash behavior
+    # as sharded/rlnc when a record lacks it.
+    (("streaming", "value"), "streaming msgs/sec", True),
+    (("streaming", "constant", "sustained_msgs_per_sec"),
+     "streaming constant msgs/sec", True),
+    (("streaming", "constant", "ingest_p50_s"),
+     "streaming constant ingest p50 (s)", False),
+    (("streaming", "constant", "ingest_p99_s"),
+     "streaming constant ingest p99 (s)", False),
+    (("streaming", "constant", "max_queue_depth"),
+     "streaming constant peak depth", False),
+    (("streaming", "burst", "sustained_msgs_per_sec"),
+     "streaming burst msgs/sec", True),
+    (("streaming", "burst", "ingest_p99_s"),
+     "streaming burst ingest p99 (s)", False),
+    (("streaming", "burst", "max_queue_depth"),
+     "streaming burst peak depth", False),
+    (("streaming", "hot", "sustained_msgs_per_sec"),
+     "streaming hot msgs/sec", True),
+    (("streaming", "hot", "ingest_p99_s"),
+     "streaming hot ingest p99 (s)", False),
+    (("streaming", "warmup_s"), "streaming warmup (s)", False),
 ]
 
 
@@ -244,6 +266,28 @@ def context_warnings(old: Dict[str, Any], new: Dict[str, Any]) -> List[str]:
             if ro.get(key) != rn.get(key):
                 warns.append(
                     f"rlnc {key} differs: {ro.get(key)!r} vs {rn.get(key)!r}"
+                )
+    # Streaming serving-plane section (r12+): same treatment.
+    to, tn = old.get("streaming"), new.get("streaming")
+    if (to is None) != (tn is None):
+        which = "old" if to is None else "new"
+        warns.append(
+            f"only one record has a 'streaming' section (missing in {which}; "
+            f"added in r12) — streaming rows are one-sided"
+        )
+    for name, s in (("old", to), ("new", tn)):
+        if isinstance(s, dict) and "error" in s:
+            warns.append(
+                f"{name} streaming section is an error record: "
+                f"{str(s['error'])[:200]}"
+            )
+    if (isinstance(to, dict) and isinstance(tn, dict)
+            and "error" not in to and "error" not in tn):
+        for key in ("backend", "n_peers", "chunk_steps"):
+            if to.get(key) != tn.get(key):
+                warns.append(
+                    f"streaming {key} differs: {to.get(key)!r} vs "
+                    f"{tn.get(key)!r}"
                 )
     return warns
 

@@ -11,10 +11,16 @@ Usage::
     python tools/scenario_run.py --replay trace.json  # bit-for-bit check
     python tools/scenario_run.py --json               # machine-readable
     python tools/scenario_run.py --plane live degraded_links churn_10pct
+    python tools/scenario_run.py --plane streaming streaming_steady
 
 ``--plane live`` runs the campaigns over real sockets: link windows become
 chaos delay policies, churn becomes host kills, and the SAME SLO
 thresholds grade the socket-level run (scenario.live_runner).
+
+``--plane streaming`` replays the campaign's workloads as an OPEN stream
+through the serving plane (crypto stage -> ingest ring -> resident engine,
+scenario.streaming_runner) and grades the streaming SLO channels (queue
+depth, exact ingest latency, zero silent drops).
 
 Exit code 0 iff every verdict passed (and, with ``--replay``, the stored
 flight record reproduced exactly) — the scenario suite is a regression
@@ -69,9 +75,11 @@ def main(argv: List[str] | None = None) -> int:
                     help="write the (single) run's replayable trace here")
     ap.add_argument("--json", action="store_true",
                     help="emit verdicts as JSON instead of the table")
-    ap.add_argument("--plane", choices=("sim", "live"), default="sim",
-                    help="execution plane: device-compiled sim (default) or "
-                    "real sockets under chaos")
+    ap.add_argument("--plane", choices=("sim", "live", "streaming"),
+                    default="sim",
+                    help="execution plane: device-compiled sim (default), "
+                    "real sockets under chaos, or the streaming serving "
+                    "plane (ring + resident engine)")
     ap.add_argument("--live-hosts", type=int, default=None, metavar="N",
                     help="live plane: number of hosts (default 16, or the "
                     "spec's live.n_hosts)")
@@ -86,8 +94,9 @@ def main(argv: List[str] | None = None) -> int:
             planes = [p for p, ok in (
                 ("sim", scenario.sim_supported(s)),
                 ("live", scenario.live_supported(s)),
+                ("streaming", scenario.streaming_supported(s)),
             ) if ok]
-            print(f"{name:<26} {'+'.join(planes):<8} {s.description}")
+            print(f"{name:<26} {'+'.join(planes):<10} {s.description}")
         return 0
 
     if args.replay:
@@ -119,7 +128,7 @@ def main(argv: List[str] | None = None) -> int:
 
     if args.save_trace and len(specs) != 1:
         ap.error("--save-trace takes exactly one scenario")
-    if args.plane == "live" and (args.save_trace or args.replay):
+    if args.plane != "sim" and (args.save_trace or args.replay):
         ap.error("--save-trace/--replay are sim-plane features")
 
     if args.plane == "live" and not args.names and not args.spec:
@@ -131,12 +140,21 @@ def main(argv: List[str] | None = None) -> int:
             print(f"# live plane: skipping unsupported canon: "
                   f"{', '.join(skipped)}", file=sys.stderr)
     if args.plane == "sim" and not args.names and not args.spec:
-        # Mirror filter: live-only canon (root failover, socket partition
-        # heal) has no device lowering and is skipped from the sim sweep.
+        # Mirror filter: live-only and streaming-only canon (root failover,
+        # socket partition heal, serving-plane streams) have no device
+        # lowering and are skipped from the sim sweep.
         skipped = [s.name for s in specs if not scenario.sim_supported(s)]
         specs = [s for s in specs if scenario.sim_supported(s)]
         if skipped:
-            print(f"# sim plane: skipping live-only canon: "
+            print(f"# sim plane: skipping live/streaming-only canon: "
+                  f"{', '.join(skipped)}", file=sys.stderr)
+    if args.plane == "streaming" and not args.names and not args.spec:
+        # Streaming sweep: only what the serving plane can replay.
+        skipped = [s.name for s in specs
+                   if not scenario.streaming_supported(s)]
+        specs = [s for s in specs if scenario.streaming_supported(s)]
+        if skipped:
+            print(f"# streaming plane: skipping unsupported canon: "
                   f"{', '.join(skipped)}", file=sys.stderr)
 
     results = []
@@ -153,6 +171,12 @@ def main(argv: List[str] | None = None) -> int:
             except scenario.LivePlaneError as e:
                 print(f"error: {e}", file=sys.stderr)
                 return 2
+        elif args.plane == "streaming":
+            try:
+                res = scenario.run_streaming_scenario(spec)
+            except scenario.StreamingPlaneError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
         else:
             res = scenario.run_scenario(spec)
         res.seconds = round(time.time() - t0, 3)
@@ -165,8 +189,9 @@ def main(argv: List[str] | None = None) -> int:
         print(json.dumps(
             [dict(res.verdict.to_dict(), family=res.spec.family,
                   plane=args.plane,
-                  n_publishes=(res.n_publishes if args.plane == "live"
-                               else res.compiled.n_publishes),
+                  n_publishes=(res.compiled.n_publishes
+                               if args.plane == "sim"
+                               else res.n_publishes),
                   seconds=res.seconds)
              for res in results],
             indent=2,
