@@ -1252,8 +1252,25 @@ def child_main() -> None:
         scen_res = scenario.run_scenario(scenario.build("steady_state"))
         scenario_verdict = scen_res.verdict.to_dict()
         log(f"scenario smoke: {scen_res.verdict}")
+        # Canon inventory rider (r13+): the suite's size and shape next to
+        # the smoke verdict, so a cross-round diff notices canon shrinking
+        # or an attack family disappearing without running the (slow) full
+        # sweep here — tools/scenario_run.py and the tier-1 gate grade the
+        # verdicts themselves.
+        canon_specs = scenario.build_all()
+        scenario_canon = {
+            "count": len(canon_specs),
+            "attack_count": sum(1 for s in canon_specs if s.attacks),
+            "attack_kinds": sorted(
+                {w.kind for s in canon_specs for w in (s.attacks or [])}
+            ),
+            "verdicts": {"steady_state": bool(scen_res.verdict.passed)},
+        }
+        log(f"scenario canon: {scenario_canon['count']} entries, "
+            f"{scenario_canon['attack_count']} attack campaigns")
     except Exception as e:  # pragma: no cover - diagnostic surface
         scenario_verdict = {"error": f"{type(e).__name__}: {e}"}
+        scenario_canon = {"error": f"{type(e).__name__}: {e}"}
         log(f"scenario smoke FAILED to run: {scenario_verdict['error']}")
 
     trace_out = os.environ.get("BENCH_TRACE_OUT")
@@ -1294,6 +1311,7 @@ def child_main() -> None:
                 "phase_breakdown_ms": phases,
                 "flight": flight,
                 "scenario_smoke": scenario_verdict,
+                "scenario_canon": scenario_canon,
                 "ed25519_device_scaling": device_curve,
                 "ed25519_native_sigs_per_sec": round(native_sigs_per_sec, 1),
                 "treecast_10peer_deliveries_per_sec": round(tree_msgs_per_sec, 1),

@@ -40,16 +40,14 @@ def _attacker_metrics(
     att_slot = st.nbr_valid & attackers[jnp.clip(st.nbrs, 0, n - 1)]
     honest = ~attackers & st.alive
     in_honest_mesh = (st.mesh & att_slot & honest[:, None]).sum()
-    att_scores = jnp.where(att_slot, st.scores, jnp.nan)
+    # Explicit masked reductions (GossipSub.masked_mean/min): NaN silently
+    # when the attacker set is empty — never numpy's all-NaN-slice warning.
     return {
         "attacker_mesh_edges": in_honest_mesh.astype(jnp.int32),
-        "attacker_score_mean": jnp.nanmean(att_scores),
-        "honest_score_min": jnp.nanmin(
-            jnp.where(
-                st.nbr_valid & ~att_slot & jnp.isfinite(st.scores),
-                st.scores,
-                jnp.nan,
-            )
+        "attacker_score_mean": GossipSub.masked_mean(st.scores, att_slot),
+        "honest_score_min": GossipSub.masked_min(
+            st.scores,
+            st.nbr_valid & ~att_slot & jnp.isfinite(st.scores),
         ),
     }
 

@@ -81,6 +81,9 @@ class GossipState(NamedTuple):
     gossip_mute: jax.Array  # bool[N] peers that advertise but never serve
                             # IWANTs (promise-breaking adversary model; their
                             # refusals charge P7)
+    self_promo: jax.Array   # bool[N] peers whose IHAVEs advertise only ids
+                            # they ORIGINATED (crafted self-promotion
+                            # gossip; see _heartbeat's advertise restriction)
     gossip_delay: jax.Array  # i32[N] ingress link latency: extra rounds a
                              # peer's pending gossip/flood transfers wait
                              # before folding into receipts (the per-edge
@@ -514,6 +517,7 @@ class GossipSub:
             gossip_pend_w=jnp.zeros((n, w), jnp.uint32),
             iwant_pend_w=jnp.zeros((n, w), jnp.uint32),
             gossip_mute=jnp.zeros((n,), bool),
+            self_promo=jnp.zeros((n,), bool),
             gossip_delay=jnp.zeros((n,), jnp.int32),
             pend_hold=jnp.zeros((n,), jnp.int32),
             edge_delay=jnp.zeros((n, k), jnp.int32),
@@ -712,6 +716,17 @@ class GossipSub:
         return st._replace(gossip_mute=mask)
 
     @functools.partial(jax.jit, static_argnums=0)
+    def set_self_promo(self, st: GossipState, mask: jax.Array) -> GossipState:
+        """Mark peers (bool[N]) as IHAVE self-promoters: their
+        advertisements are restricted to messages they themselves
+        ORIGINATED (receipt latency 0 — only the publisher is stamped at
+        birth step), so they never gossip honest traffic onward.  The
+        crafted-IHAVE adversary model of the ``self_promo_ihave`` scenario
+        wave; composed with ``gossip_mute`` the asks their self-ads attract
+        become broken promises charged to P7."""
+        return st._replace(self_promo=mask)
+
+    @functools.partial(jax.jit, static_argnums=0)
     def set_subscribed(self, st: GossipState, sub: jax.Array) -> GossipState:
         """Change topic membership (bool[N]).
 
@@ -875,8 +890,25 @@ class GossipSub:
         gossip_edges = edge_live & nbr_sub
         if self.direct_edges is not None:
             gossip_edges = gossip_edges & ~self.direct_edges
+        # Self-promoters advertise ONLY ids they originated (receipt latency
+        # 0 == the publisher's own birth stamp), feeding a restricted
+        # advertise-source view into the exchange; the dedup view and the
+        # stored possession stay untouched.  cond-gated: honest runs pay one
+        # predicate, never the [N, M] origin unpack.
+        adv_src = jax.lax.cond(
+            st.self_promo.any(),
+            lambda: jnp.where(
+                st.self_promo[:, None],
+                st.have_w & bitpack.pack(
+                    (st.first_step == st.msg_birth[None, :])
+                    & st.msg_used[None, :]
+                ),
+                st.have_w,
+            ),
+            lambda: st.have_w,
+        )
         exchange_args = (
-            kgossip, kiwant, st.have_w, have_w, new_mesh, px.nbrs, px.rev,
+            kgossip, kiwant, adv_src, have_w, new_mesh, px.nbrs, px.rev,
             gossip_edges, part, scores, gossip_w, p,
             sp.gossip_threshold, serve_ok, p.max_iwant_length,
         )
@@ -1196,6 +1228,23 @@ class GossipSub:
 
     # -- scenario engine ----------------------------------------------------
 
+    @staticmethod
+    def masked_mean(x: jax.Array, mask: jax.Array) -> jax.Array:
+        """Mean of ``x`` over ``mask`` — NaN (silently) when the mask is
+        empty.  The adversary-standing channels' reduction: equal to
+        ``nanmean(where(mask, x, nan))`` for finite ``x`` but with the
+        empty-set semantics explicit instead of riding numpy's all-NaN
+        slice warning path."""
+        cnt = mask.sum()
+        total = jnp.where(mask, x, 0.0).sum()
+        return jnp.where(cnt > 0, total / jnp.maximum(cnt, 1), jnp.nan)
+
+    @staticmethod
+    def masked_min(x: jax.Array, mask: jax.Array) -> jax.Array:
+        """Min of ``x`` over ``mask`` — NaN (silently) when empty."""
+        lo = jnp.where(mask, x, jnp.inf).min()
+        return jnp.where(mask.any(), lo, jnp.nan)
+
     def _apply_events(self, st: GossipState, ev) -> GossipState:
         """Apply one step's slice of a ``GossipEvents`` schedule (scan body;
         every branch is ``lax.cond``-gated so quiet steps pay one predicate
@@ -1241,6 +1290,14 @@ class GossipSub:
             st,
         )
         st = jax.lax.cond(
+            ev.promo_on.any() | ev.promo_off.any(),
+            lambda s: s._replace(
+                self_promo=(s.self_promo & ~ev.promo_off) | ev.promo_on
+            ),
+            lambda s: s,
+            st,
+        )
+        st = jax.lax.cond(
             (ev.delay >= 0).any(),
             lambda s: s._replace(
                 gossip_delay=jnp.where(ev.delay >= 0, ev.delay, s.gossip_delay)
@@ -1277,32 +1334,30 @@ class GossipSub:
             honest = ~attackers & st.alive
             honest_mesh = st.mesh & st.nbr_valid & honest[:, None]
             captured = (st.mesh & att_slot & honest[:, None]).sum()
-            att_scores = jnp.where(att_slot, st.scores, jnp.nan)
             rec["attacker_mesh_edges"] = captured.astype(jnp.int32)
             # Mesh-capture ceiling: fraction of honest peers' mesh slots an
             # attacker occupies — the eclipse/sybil SLO channel.
             rec["attacker_capture_frac"] = captured / jnp.maximum(
                 honest_mesh.sum(), 1
             )
-            rec["attacker_score_mean"] = jnp.nanmean(att_scores)
-            rec["honest_score_min"] = jnp.nanmin(
-                jnp.where(
-                    st.nbr_valid & ~att_slot & jnp.isfinite(st.scores),
-                    st.scores,
-                    jnp.nan,
-                )
+            # Score-standing channels reduce over explicit masks (NaN when
+            # the slice is empty — e.g. an all-False attacker set — rather
+            # than numpy's warning-prone all-NaN path; see masked_mean).
+            rec["attacker_score_mean"] = self.masked_mean(
+                st.scores, att_slot
+            )
+            rec["honest_score_min"] = self.masked_min(
+                st.scores,
+                st.nbr_valid & ~att_slot & jnp.isfinite(st.scores),
             )
             rec["attacker_behaviour_penalty"] = (
                 st.gcounters.behaviour_penalty.max(
                     where=attackers, initial=0.0
                 )
             )
-            rec["attacker_global_score"] = jnp.nanmean(
-                jnp.where(
-                    attackers,
-                    scoring_ops.global_score(st.gcounters, self.score_params),
-                    jnp.nan,
-                )
+            rec["attacker_global_score"] = self.masked_mean(
+                scoring_ops.global_score(st.gcounters, self.score_params),
+                attackers,
             )
             rec["honest_behaviour_penalty_max"] = jnp.where(
                 ~attackers, st.gcounters.behaviour_penalty, 0.0

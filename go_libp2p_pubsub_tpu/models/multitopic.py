@@ -373,7 +373,9 @@ class MultiTopicGossipSub:
                 fanout_age=inactive_age, backoff=backoff, counters=counters,
                 gcounters=st.gcounters, scores=st.scores, have_w=have_w,
                 fresh_w=fresh_w, gossip_pend_w=pend_w, iwant_pend_w=iwant_w,
-                gossip_mute=st.gossip_mute, gossip_delay=st.gossip_delay,
+                gossip_mute=st.gossip_mute,
+                self_promo=jnp.zeros((self.n,), bool),
+                gossip_delay=st.gossip_delay,
                 pend_hold=hold, edge_delay=no_edge_delay, fresh_hist=no_hist,
                 first_step=first_step,
                 msg_valid=mv, msg_birth=mb, msg_active=ma, msg_used=mu,

@@ -50,6 +50,10 @@ class GossipEvents(NamedTuple):
     sub_on: np.ndarray    # bool[T, N] (re)subscribe
     mute_on: np.ndarray   # bool[T, N] become a gossip promise-breaker
     mute_off: np.ndarray  # bool[T, N] stop being one
+    promo_on: np.ndarray  # bool[T, N] become a self-promoter: IHAVEs
+    #                       advertise only self-originated ids (the crafted
+    #                       gossip of the self_promo_ihave adversary)
+    promo_off: np.ndarray  # bool[T, N] stop self-promoting
     delay: np.ndarray     # i32[T, N] set ingress gossip delay; -1 = keep
     silence: np.ndarray   # bool[T, N] zero the peer's fresh words after the
     #                       step (no eager relay this round)
@@ -86,7 +90,7 @@ def empty_gossip_events(n_steps: int, n: int, pub_width: int = 1) -> GossipEvent
     z = lambda: np.zeros((n_steps, n), bool)
     return GossipEvents(
         kill=z(), revive=z(), sub_off=z(), sub_on=z(),
-        mute_on=z(), mute_off=z(),
+        mute_on=z(), mute_off=z(), promo_on=z(), promo_off=z(),
         delay=np.full((n_steps, n), -1, np.int32),
         silence=z(),
         pub_src=np.full((n_steps, pub_width), -1, np.int32),

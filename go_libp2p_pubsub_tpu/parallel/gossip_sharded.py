@@ -53,7 +53,8 @@ _PEER_DIM_FIELDS = frozenset({
     "nbrs", "rev", "nbr_valid", "outbound", "alive", "subscribed",
     "edge_live", "nbr_sub", "mesh", "fanout", "fanout_age", "backoff",
     "counters", "gcounters", "scores", "have_w", "fresh_w",
-    "gossip_pend_w", "iwant_pend_w", "gossip_mute", "gossip_delay",
+    "gossip_pend_w", "iwant_pend_w", "gossip_mute", "self_promo",
+    "gossip_delay",
     "pend_hold", "edge_delay", "fresh_hist", "first_step",
 })
 _REPLICATED_FIELDS = frozenset({

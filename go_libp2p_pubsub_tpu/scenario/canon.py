@@ -159,6 +159,223 @@ def spam_flood() -> ScenarioSpec:
     )
 
 
+def cold_boot_eclipse() -> ScenarioSpec:
+    """Monopolists own the target's mesh from step 0 — before any P1/P2
+    history exists on either side (the compiler zeroes the touched edges'
+    counters).  The P3 delivery-deficit defense must evict the silent
+    monopolists on fresh evidence alone and re-open honest slots."""
+    return ScenarioSpec(
+        name="cold_boot_eclipse",
+        family="gossipsub",
+        n_steps=48,
+        seed=67,
+        model=dict(
+            n_peers=96, n_slots=32, conn_degree=20, msg_window=32,
+            heartbeat_steps=4,
+            score_params={
+                "mesh_message_deliveries_weight": -1.0,
+                "mesh_message_deliveries_threshold": 1.5,
+                "mesh_message_deliveries_activation_s": 3.0,
+            },
+        ),
+        workloads=[Workload(kind="constant", start=2, stop=40, every=2)],
+        attacks=[AttackWave(
+            kind="cold_boot_eclipse", target=5, n_attackers=8,
+            start=0, stop=40,
+        )],
+        # Measured (seed 67): target regains 3 honest edges, delivery 1.00,
+        # attackers at -0.84; P3 drags honest bystanders to -0.71 before
+        # activation, hence the generous floor.
+        slo=SLO(
+            min_delivery_frac=0.97,
+            min_final_target_honest_edges=1,
+            max_final_attacker_score=-0.25,
+            min_final_honest_score=-2.0,
+        ),
+        description="8 score-less monopolists own peer 5's mesh at boot; "
+                    "P3 deficit evidence must evict them.",
+    )
+
+
+def covert_flash() -> ScenarioSpec:
+    """Attackers behave honestly for 16 rounds, then defect simultaneously
+    (silence + gossip mute + invalid spam).  Reaction time is the test: the
+    P4 hammer must bury the flash mob even though it defects with banked
+    honest reputation."""
+    return ScenarioSpec(
+        name="covert_flash",
+        family="gossipsub",
+        n_steps=48,
+        seed=71,
+        model=dict(
+            n_peers=96, n_slots=16, conn_degree=8, msg_window=64,
+            heartbeat_steps=4,
+            score_params={"invalid_message_deliveries_weight": -30.0},
+        ),
+        workloads=[Workload(kind="constant", start=2, stop=40, every=2)],
+        attacks=[AttackWave(
+            kind="covert_flash", n_attackers=6, start=0, stop=40,
+            defect_step=16, spam_every=4,
+        )],
+        # Measured (seed 71): attackers end at -1.15, honest floor exactly
+        # 0.0, delivery 1.00.
+        slo=SLO(
+            min_delivery_frac=0.97,
+            max_final_attacker_score=-0.5,
+            min_final_honest_score=-0.25,
+        ),
+        description="6 sleepers defect at step 16 with spam + silence.",
+    )
+
+
+def score_farm() -> ScenarioSpec:
+    """Attackers bank P1/P2 credit with valid publishes for 16 rounds,
+    then cash it in as invalid-spam cover.  The squared P4 penalty (and
+    P2's fast decay) must overcome the farmed reputation."""
+    return ScenarioSpec(
+        name="score_farm",
+        family="gossipsub",
+        n_steps=48,
+        seed=73,
+        model=dict(
+            n_peers=96, n_slots=16, conn_degree=8, msg_window=96,
+            heartbeat_steps=4,
+            score_params={"invalid_message_deliveries_weight": -80.0},
+        ),
+        workloads=[Workload(kind="constant", start=2, stop=40, every=4)],
+        attacks=[AttackWave(
+            kind="score_farm", n_attackers=3, start=2, farm_steps=16,
+            spam_every=2,
+        )],
+        # Measured (seed 73): farmed credit peaks ~+0.5 mid-farm; the spam
+        # phase drives the attackers to about -5.6 while honest stays at 0.
+        slo=SLO(
+            min_delivery_frac=0.97,
+            max_final_attacker_score=-1.0,
+            min_final_honest_score=-0.25,
+        ),
+        description="3 farmers bank 16 rounds of valid P2 credit, then "
+                    "flip to invalid spam.",
+    )
+
+
+def self_promo_ihave() -> ScenarioSpec:
+    """Crafted gossip: attackers publish valid self-originated traffic,
+    advertise ONLY their own ids, and never serve the IWANTs those ads
+    attract.  On a delayed fabric (where gossip actually carries traffic)
+    every unserved ask charges P7 — promise tracking must bury the
+    promoters while their P2 credit stays honestly earned."""
+    return ScenarioSpec(
+        name="self_promo_ihave",
+        family="gossipsub",
+        n_steps=48,
+        seed=79,
+        model=dict(
+            n_peers=96, n_slots=16, conn_degree=8, msg_window=96,
+            heartbeat_steps=2,
+            score_params={"behaviour_penalty_weight": -5.0},
+        ),
+        workloads=[Workload(kind="constant", start=2, stop=40, every=2)],
+        links=[LinkWindow(start=0, stop=44, delay=2, frac=1.0)],
+        attacks=[AttackWave(
+            kind="self_promo_ihave", n_attackers=4, start=2, stop=44,
+            spam_every=4,
+        )],
+        # Measured (seed 79): broken-promise counter reaches ~2.7 per
+        # attacker; squared P7 lands them at -9.2 with honest floor 0.0 and
+        # delivery 0.994 despite the +2 global ingress delay.
+        slo=SLO(
+            min_delivery_frac=0.97,
+            max_final_attacker_score=-2.0,
+            min_final_honest_score=-0.25,
+        ),
+        description="4 self-promoters craft IHAVEs for their own ids and "
+                    "ghost the asks; P7 promise tracking answers.",
+    )
+
+
+def partition_flood() -> ScenarioSpec:
+    """A fifth of the mesh is partitioned away; the moment it heals, the
+    attackers open an invalid-spam flood timed to pollute the gossip
+    backfill the healed cohort depends on.  P4 must shut the flood down
+    without starving the heal."""
+    return ScenarioSpec(
+        name="partition_flood",
+        family="gossipsub",
+        n_steps=56,
+        seed=83,
+        model=dict(
+            n_peers=96, n_slots=16, conn_degree=8, msg_window=96,
+            heartbeat_steps=4,
+            params={"history_gossip": 3},
+            score_params={"invalid_message_deliveries_weight": -30.0},
+        ),
+        workloads=[Workload(kind="constant", start=2, stop=48, every=2)],
+        attacks=[AttackWave(
+            kind="partition_flood", n_attackers=4, start=10, stop=26,
+            partition_frac=0.2, flood_offset=2, spam_every=2,
+        )],
+        # Measured (seed 83): delivery 0.97 across the cut, attackers
+        # buried at -8.7, honest floor 0.0.
+        slo=SLO(
+            min_delivery_frac=0.90,
+            max_final_attacker_score=-2.0,
+            min_final_honest_score=-0.25,
+        ),
+        description="19 peers cut for 16 rounds; spam flood opens 2 rounds "
+                    "after the heal.",
+    )
+
+
+def fuzz_regression_cold_boot() -> ScenarioSpec:
+    """Regression for the fuzzer's first finding (tools/scenario_fuzz.py,
+    budget 40, seed 0, sample 0): ONE silent attacker that owns a single
+    target mesh slot from boot keeps a clean standing for the whole
+    campaign under the standing config — P3 disabled means no deficit
+    evidence ever accrues, and the SLO's ``max_final_attacker_score``
+    goes red (+0.08 > -0.25).  The committed red replay is
+    ``tests/golden/fuzz_red_cold_boot.json``; this entry is its fixed
+    twin — the SAME attack under the hardened config (P3 enabled) must
+    grade green against the SAME standing SLO."""
+    return ScenarioSpec(
+        name="fuzz_regression_cold_boot",
+        family="gossipsub",
+        n_steps=24,
+        seed=643811320,  # the fuzzed sample's own lowering seed
+        model=dict(
+            n_peers=64, n_slots=16, conn_degree=8, msg_window=128,
+            heartbeat_steps=4,
+            # HARDENED_DEFENSE in tools/scenario_fuzz.py: the standing
+            # config + P3 — the fix for the cold-boot monopoly.
+            score_params={
+                "invalid_message_deliveries_weight": -30.0,
+                "ip_colocation_factor_weight": -1.0,
+                "ip_colocation_factor_threshold": 1.0,
+                "mesh_message_deliveries_weight": -1.0,
+                "mesh_message_deliveries_threshold": 1.5,
+                "mesh_message_deliveries_activation_s": 3.0,
+            },
+        ),
+        workloads=[Workload(kind="constant", start=2, stop=20, every=2)],
+        attacks=[AttackWave(
+            kind="cold_boot_eclipse", target=5, n_attackers=1,
+            start=3, stop=24,
+        )],
+        # Measured: attacker buried at -7.67 on P3 deficit, target regains
+        # 3 honest edges, delivery 0.941, honest floor 0.0 — green on the
+        # fuzzer's standing SLO where the standing config grades red.
+        slo=SLO(
+            min_delivery_frac=0.90,
+            max_capture_frac=0.35,
+            min_final_target_honest_edges=1,
+            max_final_attacker_score=-0.25,
+            min_final_honest_score=-2.0,
+        ),
+        description="Fuzzer-found cold-boot monopoly (seed 0, sample 0), "
+                    "minimized and replayed under the hardened config.",
+    )
+
+
 def degraded_links() -> ScenarioSpec:
     """A quarter of the mesh behind slow ingress links for a window —
     deliveries hold, the latency tail pays."""
@@ -376,6 +593,12 @@ CANON: Dict[str, Callable[[], ScenarioSpec]] = {
     "sybil_colocation": sybil_colocation,
     "eclipse_backoff_spam": eclipse_backoff_spam,
     "spam_flood": spam_flood,
+    "cold_boot_eclipse": cold_boot_eclipse,
+    "covert_flash": covert_flash,
+    "score_farm": score_farm,
+    "self_promo_ihave": self_promo_ihave,
+    "partition_flood": partition_flood,
+    "fuzz_regression_cold_boot": fuzz_regression_cold_boot,
     "degraded_links": degraded_links,
     "degraded_links_rlnc": degraded_links_rlnc,
     "tree_churn_heal": tree_churn_heal,

@@ -26,7 +26,10 @@ workloads           x       (root)       x         x
 
 (rlnc has no mesh/score plane, so attack waves do not lower; its link
 windows install ingress DECIMATION — fragments outside the accept gate
-are lost, not held — see ``models/rlnc.py``.)
+are lost, not held — see ``models/rlnc.py``.  Multitopic lowers only the
+``spam``/``promise_spam``/``sybil`` kinds; the taxonomy kinds in
+``_GOSSIP_ONLY_KINDS`` need gossipsub's promo/silence tensors and score
+surgery.)
 """
 
 from __future__ import annotations
@@ -127,10 +130,23 @@ def _init_tree_state(model, spec: ScenarioSpec):
     return st
 
 
-def _eclipse_wave(spec: ScenarioSpec):
-    waves = [a for a in spec.attacks if a.kind == "eclipse"]
+# Both targeted kinds need the flight recorder's single target channel, so
+# a scenario carries at most one of them.
+_TARGETED_KINDS = ("eclipse", "cold_boot_eclipse")
+# The taxonomy extension rides the gossipsub event tensors (promo/silence)
+# and score surgery that the multitopic plane does not carry.
+_GOSSIP_ONLY_KINDS = (
+    "cold_boot_eclipse", "covert_flash", "score_farm", "self_promo_ihave",
+    "partition_flood",
+)
+
+
+def _targeted_wave(spec: ScenarioSpec):
+    waves = [a for a in spec.attacks if a.kind in _TARGETED_KINDS]
     if len(waves) > 1:
-        raise ValueError("at most one eclipse wave per scenario")
+        raise ValueError(
+            "at most one eclipse / cold_boot_eclipse wave per scenario"
+        )
     return waves[0] if waves else None
 
 
@@ -171,29 +187,53 @@ def _compile_gossip_like(spec: ScenarioSpec) -> CompiledScenario:
     model = build_model(spec)
     st = model.init(seed=spec.seed)
     n = model.n
-    ecl = _eclipse_wave(spec)
+    ecl = _targeted_wave(spec)
     target = ecl.target if ecl else None
 
+    # Per-wave attacker masks (spam/mute lowering is wave-scoped) plus the
+    # union the record channels and publisher draws exclude.
     attackers = np.zeros(n, bool)
+    wave_att: List[np.ndarray] = []
     for w in spec.attacks:
-        if w.kind == "eclipse":
+        wa = np.zeros(n, bool)
+        if multitopic and w.kind in _GOSSIP_ONLY_KINDS:
+            raise ValueError(f"{w.kind} waves are gossipsub-only")
+        if w.kind in _TARGETED_KINDS:
             if multitopic:
                 raise ValueError("eclipse waves are gossipsub-only")
             nbrs = np.asarray(st.nbrs)
-            mesh = np.asarray(st.mesh)
             if not (0 <= w.target < n):
-                raise ValueError(f"eclipse target {w.target} out of range")
-            att_ids = sorted(
-                {int(nbrs[w.target, s]) for s in range(model.k)
-                 if mesh[w.target, s]}
-            )
-            if not att_ids:
-                raise ValueError("eclipse target has an empty mesh at init")
-            attackers[att_ids] = True
+                raise ValueError(f"{w.kind} target {w.target} out of range")
+            if w.kind == "eclipse":
+                mesh = np.asarray(st.mesh)
+                att_ids = sorted(
+                    {int(nbrs[w.target, s]) for s in range(model.k)
+                     if mesh[w.target, s]}
+                )
+                if not att_ids:
+                    raise ValueError(
+                        "eclipse target has an empty mesh at init"
+                    )
+            else:  # cold_boot_eclipse: connected neighbors, slot order
+                valid = np.asarray(st.nbr_valid)
+                conn = list(dict.fromkeys(
+                    int(nbrs[w.target, s]) for s in range(model.k)
+                    if valid[w.target, s]
+                ))
+                if len(conn) < w.n_attackers:
+                    raise ValueError(
+                        f"cold_boot_eclipse wants {w.n_attackers} "
+                        f"monopolists but target {w.target} has only "
+                        f"{len(conn)} connected neighbors"
+                    )
+                att_ids = conn[: w.n_attackers]
+            wa[att_ids] = True
         else:
             if w.kind == "graft_spam" and multitopic:
                 raise ValueError("graft_spam waves are gossipsub-only")
-            attackers[: w.n_attackers] = True
+            wa[: w.n_attackers] = True
+        wave_att.append(wa)
+        attackers |= wa
 
     if any(w.graft_spam or w.kind == "graft_spam" for w in spec.attacks):
         model = build_model(spec, graft_spammers=attackers)
@@ -206,6 +246,37 @@ def _compile_gossip_like(spec: ScenarioSpec) -> CompiledScenario:
         group[attackers] = int(group.min(initial=0))
         st = st._replace(
             gcounters=st.gcounters._replace(ip_group=jnp.asarray(group))
+        )
+
+    # Cold-boot monopoly: rewrite the target's converged mesh so its ONLY
+    # mesh edges are the monopolists (symmetric via nbrs/rev), and zero the
+    # per-slot score counters on every edge the target touches — the attack
+    # lands before any P1/P2 history exists, on either side, so pruning the
+    # silent monopolists must come from fresh deficit evidence alone.
+    for ai, w in enumerate(spec.attacks):
+        if w.kind != "cold_boot_eclipse":
+            continue
+        import jax
+
+        wa = wave_att[ai]
+        mesh = np.asarray(st.mesh).copy()
+        nbrs = np.asarray(st.nbrs)
+        rev = np.asarray(st.rev)
+        valid = np.asarray(st.nbr_valid)
+        counters = jax.tree.map(lambda x: np.asarray(x).copy(), st.counters)
+        for s in range(model.k):
+            if not valid[w.target, s]:
+                continue
+            j, r = int(nbrs[w.target, s]), int(rev[w.target, s])
+            keep = bool(wa[j])
+            mesh[w.target, s] = keep
+            mesh[j, r] = keep
+            for f in counters:
+                f[w.target, s] = 0.0
+                f[j, r] = 0.0
+        st = st._replace(
+            mesh=jnp.asarray(mesh),
+            counters=jax.tree.map(jnp.asarray, counters),
         )
 
     # -- publish requests per step (src resolution deferred to the timeline
@@ -223,13 +294,57 @@ def _compile_gossip_like(spec: ScenarioSpec) -> CompiledScenario:
             for _ in range(w.n_msgs):
                 requests[t].append((rng, w.src, w.valid, w.topic))
     for ai, w in enumerate(spec.attacks):
-        if w.spam_every or w.kind == "spam":
+        ids = [int(a) for a in np.flatnonzero(wave_att[ai])]
+        if w.kind == "covert_flash":
+            start, stop = _window(w.start, w.stop, T)
+            if not (start <= w.defect_step < stop):
+                raise ValueError(
+                    f"covert_flash defect_step {w.defect_step} outside the "
+                    f"wave window [{start}, {stop})"
+                )
+            # Honest until the defect; invalid spam only after it.
+            if w.spam_every:
+                for t in range(w.defect_step, stop, w.spam_every):
+                    for a in ids:
+                        requests[t].append((None, a, False, 0))
+        elif w.kind == "score_farm":
+            start, stop = _window(w.start, w.stop, T)
+            farm_end = start + w.farm_steps
+            if farm_end >= stop:
+                raise ValueError(
+                    f"score_farm farm_steps {w.farm_steps} leaves no spam "
+                    f"phase in the wave window [{start}, {stop})"
+                )
+            # Bank valid-delivery credit, then cash it in as spam cover.
+            for t in range(start, farm_end, w.spam_every):
+                for a in ids:
+                    requests[t].append((None, a, True, 0))
+            for t in range(farm_end, stop, w.spam_every):
+                for a in ids:
+                    requests[t].append((None, a, False, 0))
+        elif w.kind == "self_promo_ihave":
+            # Valid self-originated traffic feeds the crafted IHAVEs.
+            start, stop = _window(w.start, w.stop, T)
+            for t in range(start, stop, w.spam_every):
+                for a in ids:
+                    requests[t].append((None, a, True, 0))
+        elif w.kind == "partition_flood":
+            start, stop = _window(w.start, w.stop, T)
+            flood = stop + w.flood_offset
+            if flood >= T:
+                raise ValueError(
+                    f"partition_flood flood start {flood} is past the "
+                    f"scenario end ({T} steps)"
+                )
+            for t in range(flood, T, w.spam_every):
+                for a in ids:
+                    requests[t].append((None, a, False, 0))
+        elif w.spam_every or w.kind == "spam":
             every = w.spam_every if w.spam_every else 1
             start, stop = _window(w.start, w.stop, T)
-            att_ids = np.flatnonzero(attackers)
             for t in range(start, stop, every):
-                for a in att_ids:
-                    requests[t].append((None, int(a), False, 0))
+                for a in ids:
+                    requests[t].append((None, a, False, 0))
 
     n_publishes = sum(len(r) for r in requests)
     if n_publishes > model.m:
@@ -245,15 +360,32 @@ def _compile_gossip_like(spec: ScenarioSpec) -> CompiledScenario:
     else:
         events = sched.empty_gossip_events(T, n, pub_width)
 
-    # -- attack windows -> mute / silence tensors.
-    for w in spec.attacks:
-        if w.kind in ("eclipse", "promise_spam"):
+    # -- attack windows -> mute / silence / promo tensors (wave-scoped).
+    for ai, w in enumerate(spec.attacks):
+        wa = wave_att[ai]
+        if w.kind in ("eclipse", "promise_spam", "cold_boot_eclipse"):
             start, stop = _window(w.start, w.stop, T)
-            events.mute_on[start] |= attackers
+            events.mute_on[start] |= wa
             if stop < T:
-                events.mute_off[stop] |= attackers
-            if w.kind == "eclipse":
-                events.silence[start:stop] |= attackers[None, :]
+                events.mute_off[stop] |= wa
+            if w.kind in _TARGETED_KINDS:
+                events.silence[start:stop] |= wa[None, :]
+        elif w.kind == "covert_flash":
+            start, stop = _window(w.start, w.stop, T)
+            # The mask drops at defect_step, not at wave start.
+            events.mute_on[w.defect_step] |= wa
+            if stop < T:
+                events.mute_off[stop] |= wa
+            events.silence[w.defect_step : stop] |= wa[None, :]
+        elif w.kind == "self_promo_ihave":
+            start, stop = _window(w.start, w.stop, T)
+            # Crafted IHAVEs (self-originated ids only) + never serving the
+            # IWANTs those ads attract.
+            events.promo_on[start] |= wa
+            events.mute_on[start] |= wa
+            if stop < T:
+                events.promo_off[stop] |= wa
+                events.mute_off[stop] |= wa
 
     if not multitopic and not rlnc and events.silence.any() \
             and model.max_edge_delay:
@@ -319,6 +451,25 @@ def _compile_gossip_like(spec: ScenarioSpec) -> CompiledScenario:
     ]
     churn_cursor = [0] * len(spec.churn)  # cycle index into explicit peers
     rejoin_at: List[List[tuple]] = [[] for _ in range(T + 1)]  # (ids, graceful)
+
+    # partition_flood cohorts ride the fault/rejoin machinery (kill at
+    # start, revive at stop) so the liveness mirror below stays correct for
+    # victim and publisher draws — never raw kill/revive tensor writes.
+    for ai, w in enumerate(spec.attacks):
+        if w.kind != "partition_flood":
+            continue
+        start, stop = _window(w.start, w.stop, T)
+        rng = _rng(spec.seed, _TAG_ATTACK, ai)
+        pool = np.flatnonzero(~protected)
+        size = min(max(1, int(round(w.partition_frac * n))), len(pool))
+        if size == 0:
+            raise ValueError(
+                "partition_flood found no honest unprotected peers to cut"
+            )
+        cohort = np.sort(rng.choice(pool, size=size, replace=False)).tolist()
+        churn_events[start].append(("fault_kill", cohort))
+        rejoin_at[stop].append((cohort, False))
+
     slot = 0
 
     for t in range(T):

@@ -149,6 +149,26 @@ def evaluate(
                 slo.min_final_target_honest_edges,
                 record["target_honest_mesh_edges"][-1],
             ))
+        if slo.max_final_attacker_score is not None:
+            if not have("attacker_score_mean"):
+                raise ValueError(
+                    "max_final_attacker_score SLO needs an attack wave "
+                    "(the score channels are only recorded with attackers)"
+                )
+            crits.append(_crit(
+                "final_attacker_score", "max", slo.max_final_attacker_score,
+                record["attacker_score_mean"][-1],
+            ))
+        if slo.min_final_honest_score is not None:
+            if not have("honest_score_min"):
+                raise ValueError(
+                    "min_final_honest_score SLO needs an attack wave "
+                    "(the score channels are only recorded with attackers)"
+                )
+            crits.append(_crit(
+                "final_honest_score", "min", slo.min_final_honest_score,
+                record["honest_score_min"][-1],
+            ))
 
     # Failover criteria (family-agnostic: the live runner emits these
     # channels for whatever family it ran).  Requesting one without the

@@ -30,7 +30,12 @@ from typing import Any, Dict, List, Optional
 
 FAMILIES = ("gossipsub", "treecast", "multitopic", "rlnc")
 WORKLOAD_KINDS = ("constant", "burst", "hot")
-ATTACK_KINDS = ("sybil", "eclipse", "spam", "promise_spam", "graft_spam")
+ATTACK_KINDS = (
+    "sybil", "eclipse", "spam", "promise_spam", "graft_spam",
+    # The literature's remaining catalogue (arXiv 2007.02754 / 2212.05197):
+    "cold_boot_eclipse", "covert_flash", "score_farm", "self_promo_ihave",
+    "partition_flood",
+)
 
 
 @dataclass(frozen=True)
@@ -113,25 +118,84 @@ class AttackWave:
       heartbeat for the WHOLE run (constructor-bound ``graft_spammers``),
       plus the window's invalid spam when ``spam_every > 0`` (P7 backoff
       violations).
+    - ``cold_boot_eclipse``: ``n_attackers`` of the ``target``'s CONNECTED
+      neighbors monopolize its mesh from step 0 — the compiler forces the
+      target's mesh to attacker edges only and zeroes the score history on
+      every touched edge (no banked P1/P2 to prune against); during
+      [start, stop) the monopolists receive but never relay nor serve.
+    - ``covert_flash``: attackers [0, n_attackers) behave honestly until
+      ``defect_step``, then defect simultaneously (silence + gossip mute
+      until ``stop``, plus invalid spam every ``spam_every`` steps when
+      ``spam_every > 0``) — tests that defense reaction time beats banked
+      reputation.
+    - ``score_farm``: attackers publish VALID messages every ``spam_every``
+      steps for the first ``farm_steps`` of the window (banking P1/P2
+      credit), then flip to invalid spam for the remainder — tests that
+      P4 penalties overcome farmed credit.
+    - ``self_promo_ihave``: attackers publish valid self-originated traffic
+      every ``spam_every`` steps and craft their IHAVEs to advertise ONLY
+      ids they originated, while never serving the IWANTs those ads
+      attract — inflated promise/delivery standing vs P7 promise tracking.
+    - ``partition_flood``: a random ``partition_frac`` cohort of honest
+      peers is partitioned away during [start, stop); at
+      ``stop + flood_offset`` the attackers open an invalid spam flood
+      (every ``spam_every`` steps to scenario end) timed to pollute the
+      heal's gossip backfill.
     """
 
     kind: str = "spam"
     start: int = 0
     stop: Optional[int] = None     # exclusive; None = scenario end
     n_attackers: int = 0
-    target: Optional[int] = None   # eclipse only
+    target: Optional[int] = None   # eclipse / cold_boot_eclipse only
     spam_every: int = 0            # 0 = no spam publishes
     graft_spam: bool = False       # also bind attackers as graft spammers
+    defect_step: Optional[int] = None  # covert_flash: step the mask drops
+    farm_steps: int = 0            # score_farm: valid-publish window length
+    flood_offset: int = 0          # partition_flood: heal -> flood delay
+    partition_frac: float = 0.0    # partition_flood: cohort fraction
 
     def __post_init__(self) -> None:
         if self.kind not in ATTACK_KINDS:
             raise ValueError(f"unknown attack kind {self.kind!r}")
-        if self.kind == "eclipse" and self.target is None:
-            raise ValueError("eclipse wave requires target")
+        targeted = ("eclipse", "cold_boot_eclipse")
+        if self.kind in targeted and self.target is None:
+            raise ValueError(f"{self.kind} wave requires target")
         if self.kind != "eclipse" and self.n_attackers < 1:
             raise ValueError(f"{self.kind} wave requires n_attackers >= 1")
-        if self.kind == "spam" and self.spam_every < 1:
-            raise ValueError("spam wave requires spam_every >= 1")
+        spam_kinds = ("spam", "score_farm", "self_promo_ihave",
+                      "partition_flood")
+        if self.kind in spam_kinds and self.spam_every < 1:
+            raise ValueError(f"{self.kind} wave requires spam_every >= 1")
+        # Kind-specific fields are rejected elsewhere rather than silently
+        # ignored — a farm window on an eclipse wave is a spec bug.
+        if self.defect_step is not None and self.kind != "covert_flash":
+            raise ValueError("defect_step is covert_flash-only")
+        if self.kind == "covert_flash":
+            if self.defect_step is None or self.defect_step < 0:
+                raise ValueError(
+                    "covert_flash wave requires defect_step >= 0"
+                )
+        if self.farm_steps and self.kind != "score_farm":
+            raise ValueError("farm_steps is score_farm-only")
+        if self.kind == "score_farm" and self.farm_steps < 1:
+            raise ValueError("score_farm wave requires farm_steps >= 1")
+        if self.flood_offset and self.kind != "partition_flood":
+            raise ValueError("flood_offset is partition_flood-only")
+        if self.partition_frac and self.kind != "partition_flood":
+            raise ValueError("partition_frac is partition_flood-only")
+        if self.kind == "partition_flood":
+            if self.flood_offset < 0:
+                raise ValueError("flood_offset must be >= 0")
+            if not (0.0 < self.partition_frac < 1.0):
+                raise ValueError(
+                    "partition_flood wave requires partition_frac in (0, 1)"
+                )
+            if self.stop is None:
+                raise ValueError(
+                    "partition_flood wave requires an explicit stop (the "
+                    "heal the flood is timed against)"
+                )
 
 
 @dataclass(frozen=True)
@@ -171,6 +235,12 @@ class SLO:
     max_capture_frac: Optional[float] = None         # max over the series
     max_final_attacker_mesh_edges: Optional[int] = None
     min_final_target_honest_edges: Optional[int] = None
+    # Score-standing criteria (attack waves only — graded from the
+    # ``attacker_score_mean`` / ``honest_score_min`` campaign channels):
+    # the ceiling asserts the defense buried the attackers' standing; the
+    # floor asserts no honest peer was collaterally penalized below it.
+    max_final_attacker_score: Optional[float] = None
+    min_final_honest_score: Optional[float] = None
     min_delivered_total: Optional[int] = None        # tree
     max_final_orphans: Optional[int] = None          # tree
     # Failover criteria (live plane, scenario.live_runner): graded from the

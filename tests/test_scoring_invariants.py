@@ -1,0 +1,366 @@
+"""Score-invariant property gate (the taxonomy PR's formal layer).
+
+Three invariants the defense must satisfy REGARDLESS of parameterization,
+plus the attacker-standing channel edge cases:
+
+(a) penalty monotonicity — more invalid deliveries never raises a peer's
+    score, and any invalid delivery strictly lowers it (P4's weight is
+    negative and the term is squared), checked at the ops level over a
+    parameter sweep and at the model level over whole rollouts;
+(b) bounded mesh capture — k colocated sybils hold at most a bounded
+    multiple of their fair share of honest mesh slots once P6 is enabled
+    and the mesh has converged;
+(c) honest-score floor — under EVERY canon attack campaign, no honest
+    peer's score is dragged below the collateral-damage floor (and the
+    canon verdicts themselves stay green).
+
+Each invariant runs as a deterministic numpy sweep so the gate holds in
+environments without ``hypothesis``; when hypothesis IS present, the
+ops-level properties additionally run under randomized weights/counters.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from go_libp2p_pubsub_tpu.config import ScoreParams
+from go_libp2p_pubsub_tpu.models.gossipsub import GossipSub
+from go_libp2p_pubsub_tpu.ops import schedule as sched
+from go_libp2p_pubsub_tpu.ops import scoring as scoring_ops
+from go_libp2p_pubsub_tpu.scenario import canon
+from go_libp2p_pubsub_tpu.scenario.runner import run_scenario
+
+try:
+    from hypothesis import given, settings
+    from hypothesis import strategies as hst
+
+    HAVE_HYPOTHESIS = True
+except ImportError:  # pure-numpy sweep still runs the gate
+    HAVE_HYPOTHESIS = False
+
+
+# ---------------------------------------------------------------------------
+# (a) penalty monotonicity
+# ---------------------------------------------------------------------------
+
+def _p4_scores(invalid_counts, params: ScoreParams) -> np.ndarray:
+    """Topic score of one neighbor slot as a function of its invalid-
+    delivery counter, all other counters held at zero."""
+    k = len(invalid_counts)
+    c = scoring_ops.TopicCounters.zeros(1, k)._replace(
+        invalid_message_deliveries=jnp.asarray(
+            [invalid_counts], jnp.float32
+        ),
+    )
+    return np.asarray(scoring_ops.topic_score(c, params))[0]
+
+
+def _check_p4_monotone(params: ScoreParams) -> None:
+    counts = np.array([0.0, 1.0, 2.0, 4.0, 8.0, 16.0])
+    s = _p4_scores(counts, params)
+    assert np.all(np.diff(s) <= 1e-6), (
+        f"score increased with more invalid deliveries: {s}"
+    )
+    if params.invalid_message_deliveries_weight < 0:
+        # Strict decrease once evidence exists: the squared P4 term has no
+        # lower clamp (topic_score caps only from above), so every extra
+        # invalid delivery must strictly lower the slot's score.
+        assert np.all(np.diff(s) < 0), (
+            f"invalid deliveries did not strictly lower the score: {s}"
+        )
+
+
+def test_p4_monotonicity_sweep():
+    for w in (-0.5, -1.0, -30.0, -80.0):
+        _check_p4_monotone(
+            ScoreParams(invalid_message_deliveries_weight=w)
+        )
+    # Disabled P4 (weight 0) must be exactly flat.
+    s = _p4_scores(
+        np.array([0.0, 4.0, 16.0]),
+        ScoreParams(invalid_message_deliveries_weight=0.0),
+    )
+    assert np.allclose(np.diff(s), 0.0)
+
+
+if HAVE_HYPOTHESIS:
+    # Decorators reference hypothesis names, so the randomized variants
+    # only EXIST when it's installed; the numpy sweeps above are the
+    # unconditional gate either way.
+    @settings(max_examples=50, deadline=None)
+    @given(
+        w=hst.floats(min_value=-100.0, max_value=-0.01),
+        decay=hst.floats(min_value=0.05, max_value=0.95),
+    )
+    def test_p4_monotonicity_hypothesis(w, decay):
+        _check_p4_monotone(ScoreParams(
+            invalid_message_deliveries_weight=w,
+            invalid_message_deliveries_decay=decay,
+        ))
+
+
+def test_p7_monotonicity_sweep():
+    """Behaviour penalty: more violations never raise the global score."""
+    for w in (-1.0, -5.0, -20.0):
+        p = ScoreParams(behaviour_penalty_weight=w)
+        pens = np.array([0.0, 1.0, 2.0, 5.0, 10.0], np.float32)
+        g = scoring_ops.GlobalCounters.zeros(len(pens))._replace(
+            behaviour_penalty=jnp.asarray(pens)
+        )
+        s = np.asarray(scoring_ops.global_score(g, p))
+        assert np.all(np.diff(s) < 0)
+
+
+@pytest.fixture(scope="module")
+def spam_sweep():
+    """Model-level sweep: identical campaigns except for the number of
+    invalid messages the attacker injects.  One model shape, so the three
+    rollouts share a single XLA compile."""
+    gs = GossipSub(
+        n_peers=32, n_slots=8, conn_degree=4, msg_window=16,
+        heartbeat_steps=4,
+        score_params=ScoreParams(invalid_message_deliveries_weight=-10.0),
+    )
+    attackers = np.zeros(32, bool)
+    attackers[0] = True
+    finals = {}
+    for n_spam in (0, 2, 6):
+        st = gs.init(seed=3)
+        events = sched.empty_gossip_events(16, 32, 2)
+        slot = 0
+        for t in range(2, 2 + 2 * n_spam, 2):
+            sched.add_publish(
+                events, t, {"src": 0, "slot": slot, "valid": False}
+            )
+            slot += 1
+        for t in (4, 8, 12):  # honest background either way
+            sched.add_publish(
+                events, t, {"src": 7, "slot": slot, "valid": True}
+            )
+            slot += 1
+        st, rec = gs.rollout_events(
+            st, events, attackers=jnp.asarray(attackers), record=True
+        )
+        # Trajectory MINIMUM, not the final value: once the mesh evicts
+        # the spammer its slot counters reset and the final score snaps
+        # back toward 0 — the invariant is the depth of the penalty
+        # trough while the evidence exists.
+        finals[n_spam] = float(
+            np.nanmin(np.asarray(rec["attacker_score_mean"]))
+        )
+    return finals
+
+
+def test_spam_monotone_in_rollout(spam_sweep):
+    assert spam_sweep[2] <= spam_sweep[0] + 1e-6
+    assert spam_sweep[6] <= spam_sweep[2] + 1e-6
+    # Past the evidence threshold the drop must be strict and material.
+    assert spam_sweep[6] < spam_sweep[0] - 0.5, spam_sweep
+
+
+# ---------------------------------------------------------------------------
+# (b) bounded mesh capture
+# ---------------------------------------------------------------------------
+
+def test_bounded_mesh_capture_under_sybils():
+    """k colocated sybils hold at most a bounded multiple of their fair
+    share (k/n) of honest mesh slots at converged steady state: P6's
+    squared surplus keeps their scores below honest peers, so heartbeat
+    selection caps their occupancy rather than letting them saturate."""
+    from go_libp2p_pubsub_tpu.models.attacks import sybil_colocation_attack
+
+    n = 64
+    gs = GossipSub(
+        n_peers=n, n_slots=16, conn_degree=8, msg_window=16,
+        heartbeat_steps=4,
+        score_params=ScoreParams(
+            ip_colocation_factor_weight=-1.0,
+            ip_colocation_factor_threshold=1.0,
+        ),
+    )
+    for k in (4, 8, 16):
+        st = gs.init(seed=5)
+        st, report, att = sybil_colocation_attack(gs, st, k, n_steps=24)
+        captured = int(report["attacker_mesh_edges"][-1])
+        honest = ~np.asarray(att) & np.asarray(st.alive)
+        honest_edges = int(
+            np.asarray(
+                (st.mesh & st.nbr_valid & honest[:, None]).sum()
+            )
+        )
+        fair = k / n
+        frac = captured / max(honest_edges, 1)
+        assert frac <= 2.5 * fair, (
+            f"{k} sybils hold {frac:.3f} of mesh edges "
+            f"(fair share {fair:.3f})"
+        )
+
+
+def _check_p6_monotone(k: int, thr: float) -> None:
+    """P6 at the ops level: a bigger colocation group never scores better,
+    and any surplus past the threshold is strictly penalized."""
+    p = ScoreParams(
+        ip_colocation_factor_weight=-1.0,
+        ip_colocation_factor_threshold=thr,
+    )
+    n = 64
+    groups = np.arange(n, dtype=np.int32)
+    groups[:k] = 0
+    pen = np.asarray(
+        scoring_ops.colocation_penalty(jnp.asarray(groups), p)
+    )
+    assert np.all(pen <= 0)
+    if k > thr:
+        assert pen[0] < 0
+    bigger = groups.copy()
+    bigger[: min(k + 4, n)] = 0
+    pen2 = np.asarray(
+        scoring_ops.colocation_penalty(jnp.asarray(bigger), p)
+    )
+    assert pen2[0] <= pen[0]
+
+
+def test_colocation_penalty_monotone_sweep():
+    for k in (2, 4, 8, 32):
+        for thr in (1.0, 2.0, 4.0):
+            _check_p6_monotone(k, thr)
+
+
+if HAVE_HYPOTHESIS:
+    @settings(max_examples=30, deadline=None)
+    @given(
+        k=hst.integers(min_value=2, max_value=32),
+        thr=hst.floats(min_value=1.0, max_value=4.0),
+    )
+    def test_colocation_penalty_monotone_hypothesis(k, thr):
+        _check_p6_monotone(k, thr)
+
+
+# ---------------------------------------------------------------------------
+# (c) honest-score floor over every canon attack
+# ---------------------------------------------------------------------------
+
+_ATTACK_CANON = [
+    name for name, builder in canon.CANON.items() if builder().attacks
+]
+
+
+@pytest.fixture(scope="module")
+def canon_attack_results():
+    """Run every attack canon once; shared by the floor and verdict
+    checks below (these runs are the tier-1 'canon attack suite green'
+    gate as well)."""
+    return {
+        name: run_scenario(canon.build(name)) for name in _ATTACK_CANON
+    }
+
+
+def test_canon_covers_full_taxonomy():
+    kinds = {
+        w.kind for name in _ATTACK_CANON for w in canon.build(name).attacks
+    }
+    assert {
+        "sybil", "eclipse", "spam", "cold_boot_eclipse", "covert_flash",
+        "score_farm", "self_promo_ihave", "partition_flood",
+    } <= kinds, f"canon attack coverage shrank: {sorted(kinds)}"
+
+
+def test_canon_attacks_all_green(canon_attack_results):
+    bad = {
+        name: [c.name for c in res.verdict.criteria if not c.passed]
+        for name, res in canon_attack_results.items()
+        if not res.verdict.passed
+    }
+    assert not bad, f"red canon attack verdicts: {bad}"
+
+
+def test_honest_score_floor_under_every_canon_attack(canon_attack_results):
+    """No canon attack may graylist an honest peer: the minimum honest
+    score stays above both the collateral floor and every action
+    threshold the protocol gates on."""
+    for name, res in canon_attack_results.items():
+        sp = res.compiled.model.score_params
+        floor = np.asarray(res.record["honest_score_min"], np.float64)
+        final = floor[-1]
+        assert np.isfinite(final), f"{name}: honest floor is NaN"
+        assert final >= -2.0, (
+            f"{name}: honest floor {final:.3f} below collateral bound"
+        )
+        # Never within reach of the graylist/publish gates.
+        assert final > sp.graylist_threshold / 2
+        assert final > sp.publish_threshold / 2
+
+
+def test_attacker_standing_buried_under_every_canon_attack(
+    canon_attack_results,
+):
+    """The flip side of the floor: every canon attack's SLO pins the
+    adversary's final standing below the honest floor whenever the spec
+    grades score standing at all."""
+    for name, res in canon_attack_results.items():
+        slo = res.spec.slo
+        if slo.max_final_attacker_score is None:
+            continue
+        att = float(res.record["attacker_score_mean"][-1])
+        hon = float(res.record["honest_score_min"][-1])
+        assert att < hon, (
+            f"{name}: attacker standing {att:.3f} not below honest floor "
+            f"{hon:.3f}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# attacker-standing channels: empty and emptied attacker sets
+# ---------------------------------------------------------------------------
+
+def _tiny_model():
+    return GossipSub(
+        n_peers=16, n_slots=8, conn_degree=4, msg_window=8,
+        heartbeat_steps=4,
+    )
+
+
+def test_attacker_channels_empty_set_all_nan():
+    """An all-False attacker mask must yield all-NaN score channels with
+    NO numpy all-NaN-slice warning (the masked reductions return NaN by
+    construction, not via nanmean on an empty slice)."""
+    gs = _tiny_model()
+    st = gs.init(seed=0)
+    events = sched.empty_gossip_events(8, 16, 1)
+    sched.add_publish(events, 1, {"src": 2, "slot": 0, "valid": True})
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        st, rec = gs.rollout_events(
+            st, events, attackers=jnp.zeros(16, bool), record=True
+        )
+        att = np.asarray(rec["attacker_score_mean"])
+        assert np.all(np.isnan(att))
+        # Honest channels stay finite — every peer is honest here.
+        assert np.all(np.isfinite(np.asarray(rec["honest_score_min"])))
+
+
+def test_attacker_channels_survive_attacker_death_mid_run():
+    """Killing the whole attacker set mid-campaign must not poison the
+    channels: values stay warning-free and finite (dead attackers keep
+    their last scores in the state), and the capture channel drops to 0
+    once the mesh heals around the corpses."""
+    gs = _tiny_model()
+    st = gs.init(seed=0)
+    attackers = np.zeros(16, bool)
+    attackers[:3] = True
+    events = sched.empty_gossip_events(16, 16, 1)
+    events.kill[6][:3] = True
+    sched.add_publish(events, 1, {"src": 8, "slot": 0, "valid": True})
+    sched.add_publish(events, 9, {"src": 9, "slot": 1, "valid": True})
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        st, rec = gs.rollout_events(
+            st, events, attackers=jnp.asarray(attackers), record=True
+        )
+    att = np.asarray(rec["attacker_score_mean"])
+    assert np.all(np.isfinite(att)), att
+    assert int(np.asarray(rec["attacker_mesh_edges"])[-1]) == 0
+    assert np.all(np.isfinite(np.asarray(rec["honest_score_min"])))

@@ -102,6 +102,10 @@ SCALAR_ROWS: List[Tuple[Tuple[str, ...], str, bool]] = [
     (("streaming", "hot", "ingest_p99_s"),
      "streaming hot ingest p99 (s)", False),
     (("streaming", "warmup_s"), "streaming warmup (s)", False),
+    # Scenario-canon inventory section (r13+); same warn-not-crash behavior
+    # as sharded/rlnc/streaming when a record lacks it.
+    (("scenario_canon", "count"), "canon scenario count", True),
+    (("scenario_canon", "attack_count"), "canon attack campaigns", True),
 ]
 
 
@@ -288,6 +292,37 @@ def context_warnings(old: Dict[str, Any], new: Dict[str, Any]) -> List[str]:
                 warns.append(
                     f"streaming {key} differs: {to.get(key)!r} vs "
                     f"{tn.get(key)!r}"
+                )
+    # Scenario-canon inventory section (r13+): same treatment, plus a
+    # loud word when an attack kind covered by the old canon vanished.
+    co, cn = old.get("scenario_canon"), new.get("scenario_canon")
+    if (co is None) != (cn is None):
+        which = "old" if co is None else "new"
+        warns.append(
+            f"only one record has a 'scenario_canon' section (missing in "
+            f"{which}; added in r13) — canon rows are one-sided"
+        )
+    for name, s in (("old", co), ("new", cn)):
+        if isinstance(s, dict) and "error" in s:
+            warns.append(
+                f"{name} scenario_canon section is an error record: "
+                f"{str(s['error'])[:200]}"
+            )
+    if (isinstance(co, dict) and isinstance(cn, dict)
+            and "error" not in co and "error" not in cn):
+        lost = (set(co.get("attack_kinds") or [])
+                - set(cn.get("attack_kinds") or []))
+        if lost:
+            warns.append(
+                f"canon attack kinds dropped between rounds: "
+                f"{', '.join(sorted(lost))}"
+            )
+        for vname, passed in (co.get("verdicts") or {}).items():
+            new_passed = (cn.get("verdicts") or {}).get(vname)
+            if passed and new_passed is False:
+                warns.append(
+                    f"canon smoke verdict {vname} flipped red between "
+                    f"rounds"
                 )
     return warns
 

@@ -1,0 +1,375 @@
+#!/usr/bin/env python
+"""Scenario fuzzer: seeded random search for SLO-red configs.
+
+Samples the ATTACK/ENVIRONMENT side of the :class:`ScenarioSpec` space
+(wave kind + timing + size, churn, link windows, workload cadence) against
+a STANDING defense parameterization and a standing SLO, runs each sample
+through the sim runner, and reports every red verdict.  The search is a
+pure function of ``--seed``: every draw comes from
+``np.random.default_rng([seed, _TAG_FUZZ, index])``, so a trajectory is
+reproducible bit-for-bit and a red config can be re-derived from its
+index alone.
+
+A red config can then be SHRUNK (``--shrink``): greedy coordinate descent
+over a fixed mutation schedule (drop churn, drop links, fewer attackers,
+shorter campaign, sparser spam), keeping each mutation only while the
+verdict stays red — the fixed point is a minimal reproducer, written as a
+replayable ScenarioSpec JSON (``--save-red``) for
+``tools/scenario_run.py --spec``.
+
+Usage::
+
+    python tools/scenario_fuzz.py --budget 40 --seed 0
+    python tools/scenario_fuzz.py --budget 40 --seed 0 --defense hardened
+    python tools/scenario_fuzz.py --budget 40 --seed 0 --shrink \
+        --save-red red.json
+    python tools/scenario_fuzz.py --budget 5 --seed 0 --json   # smoke
+
+Exit code 0 when the hunt completes (red findings are the OUTPUT, not a
+failure); 1 on usage errors.
+
+The first hunt this tool ran (budget 40, seed 0, standing defense) went
+27/40 red and sample 0 itself was the find: the cold-boot mesh monopoly.
+With P3 at its shipped default (disabled), a score-less adversary that
+owns a target's mesh slot from boot keeps a clean standing for the whole
+campaign — no deficit evidence ever accrues, so ``final_attacker_score``
+stays at +0.08 against the -0.25 SLO bound.  The shrinker reduced it to
+ONE attacker, no churn, no links; the committed replay at
+``tests/golden/fuzz_red_cold_boot.json`` is that fixed point re-windowed
+(attack runs to the final step, workload stops 4 rounds early) so the
+final-step grade lands inside the attack window rather than after a
+decay tail.  Its fixed twin — the SAME spec under ``HARDENED_DEFENSE``
+(P3 enabled) — is the ``fuzz_regression_cold_boot`` canon scenario:
+attacker buried at -7.67, target back to 3 honest edges, green on the
+same standing SLO.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import hashlib
+import json
+import os
+import sys
+from typing import Callable, List, Optional
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import numpy as np  # noqa: E402
+
+from go_libp2p_pubsub_tpu import scenario  # noqa: E402
+from go_libp2p_pubsub_tpu.scenario.spec import (  # noqa: E402
+    SLO, AttackWave, ChurnPhase, LinkWindow, ScenarioSpec, Workload,
+)
+
+# Fuzzer substream tag — disjoint from the compiler's per-component tags
+# (1..4 in scenario/compiler.py), so a fuzzed spec's own lowering draws
+# never alias the search's draws.
+_TAG_FUZZ = 5
+
+# The standing defense: the scored config the canon shipped BEFORE the
+# taxonomy PR — P4 hammer + P6 colocation, P3 at its shipped default
+# (disabled; upstream guidance is that its threshold must be rate-tuned).
+STANDING_DEFENSE = {
+    "invalid_message_deliveries_weight": -30.0,
+    "ip_colocation_factor_weight": -1.0,
+    "ip_colocation_factor_threshold": 1.0,
+}
+
+# The hardened config: the fix for the cold-boot monopoly the first hunt
+# found.  P3 enabled with a threshold tuned to the fuzz mesh's observed
+# steady delivery rate (~2 msgs / decay interval on the every-2 workload).
+HARDENED_DEFENSE = dict(
+    STANDING_DEFENSE,
+    mesh_message_deliveries_weight=-1.0,
+    mesh_message_deliveries_threshold=1.5,
+    mesh_message_deliveries_activation_s=3.0,
+)
+
+DEFENSES = {"standing": STANDING_DEFENSE, "hardened": HARDENED_DEFENSE}
+
+# One fixed mesh for the whole search: every sample shares the model
+# shapes, so the rollout jit cache carries across the budget.
+_FUZZ_MESH = dict(n_peers=64, n_slots=16, conn_degree=8, msg_window=128)
+
+_N_STEPS = 48
+_TARGET = 5
+
+# Attack kinds the sim plane lowers (everything in spec.ATTACK_KINDS).
+_KINDS = (
+    "sybil", "eclipse", "spam", "promise_spam", "graft_spam",
+    "cold_boot_eclipse", "covert_flash", "score_farm", "self_promo_ihave",
+    "partition_flood",
+)
+
+
+def standing_slo(has_attack: bool, targeted: bool) -> SLO:
+    """The invariant grade every sample is held to: deliveries hold, the
+    adversary's standing is buried, no honest peer pays collateral damage,
+    and a targeted victim keeps at least one honest mesh edge."""
+    kw = dict(min_delivery_frac=0.90)
+    if has_attack:
+        kw.update(
+            max_capture_frac=0.35,
+            max_final_attacker_score=-0.25,
+            min_final_honest_score=-2.0,
+        )
+    if targeted:
+        kw.update(min_final_target_honest_edges=1)
+    return SLO(**kw)
+
+
+def sample_spec(seed: int, index: int, defense: dict) -> ScenarioSpec:
+    """Draw one scenario from the search space (pure in (seed, index))."""
+    rng = np.random.default_rng([seed, _TAG_FUZZ, index])
+    hb = int(rng.choice([2, 4]))
+    model = dict(_FUZZ_MESH, heartbeat_steps=hb, score_params=dict(defense))
+
+    workloads = [Workload(
+        kind="constant", start=2, stop=int(rng.integers(36, 45)),
+        every=int(rng.choice([2, 4])),
+    )]
+
+    kind = str(rng.choice(_KINDS))
+    start = int(rng.integers(0, 8))
+    stop = int(rng.integers(start + 16, min(start + 33, _N_STEPS)))
+    kw = dict(kind=kind, start=start, stop=stop)
+    if kind in ("eclipse", "cold_boot_eclipse"):
+        kw["target"] = _TARGET
+    if kind != "eclipse":
+        kw["n_attackers"] = int(rng.integers(2, 6))
+    if kind in ("spam", "score_farm", "self_promo_ihave", "partition_flood"):
+        kw["spam_every"] = int(rng.choice([2, 4]))
+    elif kind in ("covert_flash", "graft_spam", "eclipse"):
+        kw["spam_every"] = int(rng.choice([0, 2, 4]))
+    if kind == "graft_spam":
+        kw["graft_spam"] = True
+    if kind == "covert_flash":
+        kw["defect_step"] = int(rng.integers(start, (start + stop) // 2 + 1))
+    if kind == "score_farm":
+        kw["farm_steps"] = int(rng.integers(4, max(5, (stop - start) // 2)))
+    if kind == "partition_flood":
+        kw["stop"] = min(stop, 36)
+        kw["flood_offset"] = int(rng.integers(0, 5))
+        kw["partition_frac"] = float(rng.uniform(0.1, 0.3))
+
+    churn = []
+    if rng.random() < 0.35:
+        c0 = int(rng.integers(4, 16))
+        churn.append(ChurnPhase(
+            start=c0, stop=c0 + int(rng.integers(8, 24)),
+            every=int(rng.choice([4, 8])), kills_per_event=1,
+            graceful=bool(rng.random() < 0.3),
+        ))
+    links = []
+    if rng.random() < 0.35:
+        l0 = int(rng.integers(0, 12))
+        links.append(LinkWindow(
+            start=l0, stop=l0 + int(rng.integers(12, 32)),
+            delay=int(rng.integers(1, 4)),
+            frac=float(rng.uniform(0.1, 0.6)),
+        ))
+
+    return ScenarioSpec(
+        name=f"fuzz_s{seed}_i{index:04d}",
+        family="gossipsub",
+        n_steps=_N_STEPS,
+        seed=int(rng.integers(0, 2**31 - 1)),
+        model=model,
+        workloads=workloads,
+        attacks=[AttackWave(**kw)],
+        churn=churn,
+        links=links,
+        slo=standing_slo(True, kind in ("eclipse", "cold_boot_eclipse")),
+        description=f"fuzzed {kind} campaign (search seed {seed}, "
+                    f"index {index})",
+    )
+
+
+def _digest(spec: ScenarioSpec) -> str:
+    return hashlib.sha256(spec.to_json().encode()).hexdigest()[:12]
+
+
+def _grade(spec: ScenarioSpec):
+    """Run one spec -> (status, verdict | None, failed-criteria names).
+
+    "invalid" means the spec failed compile-time validation — a boundary
+    of the search space, not a defense failure.
+    """
+    try:
+        res = scenario.run_scenario(spec)
+    except (ValueError, RuntimeError) as e:
+        return "invalid", None, [str(e).splitlines()[0][:80]]
+    v = res.verdict
+    failed = [c.name for c in v.criteria if not c.passed]
+    return ("green" if v.passed else "red"), v, failed
+
+
+# ---------------------------------------------------------------------------
+# shrinking
+# ---------------------------------------------------------------------------
+
+def _mutations(spec: ScenarioSpec) -> List[ScenarioSpec]:
+    """Candidate simplifications, most aggressive first.  Invalid
+    candidates are fine — the shrink loop grades and discards them."""
+    out: List[ScenarioSpec] = []
+    rep = dataclasses.replace
+    if spec.churn:
+        out.append(rep(spec, churn=[]))
+    if spec.links:
+        out.append(rep(spec, links=[]))
+    w = spec.attacks[0]
+    if w.kind != "eclipse" and w.n_attackers > 1:
+        out.append(rep(spec, attacks=[
+            dataclasses.replace(w, n_attackers=w.n_attackers - 1)
+        ]))
+    if spec.n_steps > 24:
+        out.append(rep(spec, n_steps=spec.n_steps - 8))
+    if w.spam_every and w.spam_every < 8:
+        out.append(rep(spec, attacks=[
+            dataclasses.replace(w, spam_every=w.spam_every * 2)
+        ]))
+    if w.stop is not None and w.stop - w.start > 16:
+        out.append(rep(spec, attacks=[
+            dataclasses.replace(w, stop=w.stop - 8)
+        ]))
+    for wl in (spec.workloads or []):
+        if wl.every < 8:
+            out.append(rep(spec, workloads=[
+                dataclasses.replace(wl, every=wl.every * 2)
+            ]))
+        break
+    return out
+
+
+def shrink(spec: ScenarioSpec, log: Callable[[str], None]) -> ScenarioSpec:
+    """Greedy coordinate descent: apply any mutation that stays red until
+    none does.  Deterministic — the mutation schedule is fixed."""
+    current = spec
+    improved = True
+    while improved:
+        improved = False
+        for cand in _mutations(current):
+            status, _, failed = _grade(cand)
+            if status == "red":
+                log(f"  shrink kept: {_describe_delta(current, cand)} "
+                    f"(still red on {', '.join(failed)})")
+                current = cand
+                improved = True
+                break
+    return current
+
+
+def _describe_delta(old: ScenarioSpec, new: ScenarioSpec) -> str:
+    if old.churn and not new.churn:
+        return "drop churn"
+    if old.links and not new.links:
+        return "drop links"
+    if old.n_steps != new.n_steps:
+        return f"n_steps {old.n_steps}->{new.n_steps}"
+    ow, nw = old.attacks[0], new.attacks[0]
+    if ow.n_attackers != nw.n_attackers:
+        return f"n_attackers {ow.n_attackers}->{nw.n_attackers}"
+    if ow.spam_every != nw.spam_every:
+        return f"spam_every {ow.spam_every}->{nw.spam_every}"
+    if ow.stop != nw.stop:
+        return f"attack stop {ow.stop}->{nw.stop}"
+    if old.workloads and new.workloads \
+            and old.workloads[0].every != new.workloads[0].every:
+        return (f"workload every {old.workloads[0].every}->"
+                f"{new.workloads[0].every}")
+    return "mutation"
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--budget", type=int, default=40,
+                    help="number of specs to sample and run (default 40)")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="search seed; the whole trajectory is a pure "
+                    "function of it (default 0)")
+    ap.add_argument("--defense", choices=sorted(DEFENSES), default="standing",
+                    help="standing score config to fuzz against")
+    ap.add_argument("--shrink", action="store_true",
+                    help="minimize the first red config found")
+    ap.add_argument("--save-red", metavar="PATH",
+                    help="write the (minimized, with --shrink) first red "
+                    "spec as replayable JSON")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the trajectory as JSON")
+    args = ap.parse_args(argv)
+    if args.budget < 1:
+        ap.error("--budget must be >= 1")
+
+    defense = DEFENSES[args.defense]
+    trajectory = []
+    first_red: Optional[ScenarioSpec] = None
+    for i in range(args.budget):
+        spec = sample_spec(args.seed, i, defense)
+        status, verdict, failed = _grade(spec)
+        entry = {
+            "index": i,
+            "digest": _digest(spec),
+            "kind": spec.attacks[0].kind,
+            "status": status,
+            "failed": failed,
+        }
+        trajectory.append(entry)
+        if not args.json:
+            extra = f"  [{', '.join(failed)}]" if failed else ""
+            print(f"{i:4d}  {entry['digest']}  "
+                  f"{entry['kind']:<18} {status:<8}{extra}")
+        if status == "red" and first_red is None:
+            first_red = spec
+
+    n_red = sum(e["status"] == "red" for e in trajectory)
+    n_inv = sum(e["status"] == "invalid" for e in trajectory)
+    summary = {
+        "seed": args.seed,
+        "budget": args.budget,
+        "defense": args.defense,
+        "red": n_red,
+        "green": args.budget - n_red - n_inv,
+        "invalid": n_inv,
+    }
+
+    minimized = None
+    if first_red is not None and args.shrink:
+        if not args.json:
+            print(f"\nshrinking first red ({first_red.name}):")
+        minimized = shrink(
+            first_red, (lambda m: None) if args.json else print
+        )
+        summary["minimized_digest"] = _digest(minimized)
+    if args.save_red:
+        red_out = minimized if minimized is not None else first_red
+        if red_out is None:
+            print("no red config found; nothing to save", file=sys.stderr)
+            return 1
+        with open(args.save_red, "w") as f:
+            f.write(red_out.to_json())
+        summary["saved"] = args.save_red
+
+    if args.json:
+        print(json.dumps(
+            {"summary": summary, "trajectory": trajectory}, indent=2
+        ))
+    else:
+        print(f"\n{summary['red']} red / {summary['green']} green / "
+              f"{summary['invalid']} invalid over {args.budget} samples "
+              f"(seed {args.seed}, defense {args.defense})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -66,6 +66,9 @@ def main(argv: List[str] | None = None) -> int:
     ap.add_argument("names", nargs="*", help="canon scenario names "
                     "(default: the whole canon)")
     ap.add_argument("--list", action="store_true", help="list canon names")
+    ap.add_argument("--family", metavar="FAMILY",
+                    help="filter --list (and the default canon sweep) to "
+                    "one spec family, e.g. gossipsub, rlnc, treecast")
     ap.add_argument("--spec", action="append", default=[],
                     help="run a ScenarioSpec JSON file (repeatable)")
     ap.add_argument("--replay", action="append", default=[],
@@ -76,10 +79,11 @@ def main(argv: List[str] | None = None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="emit verdicts as JSON instead of the table")
     ap.add_argument("--plane", choices=("sim", "live", "streaming"),
-                    default="sim",
+                    default=None,
                     help="execution plane: device-compiled sim (default), "
                     "real sockets under chaos, or the streaming serving "
-                    "plane (ring + resident engine)")
+                    "plane (ring + resident engine); with --list, filters "
+                    "to canon the plane supports")
     ap.add_argument("--live-hosts", type=int, default=None, metavar="N",
                     help="live plane: number of hosts (default 16, or the "
                     "spec's live.n_hosts)")
@@ -87,16 +91,29 @@ def main(argv: List[str] | None = None) -> int:
                     help="live plane: wall-clock milliseconds per scenario "
                     "step (default 50, or the spec's live.step_ms)")
     args = ap.parse_args(argv)
+    plane = args.plane or "sim"
 
     if args.list:
+        supported = {
+            "sim": scenario.sim_supported,
+            "live": scenario.live_supported,
+            "streaming": scenario.streaming_supported,
+        }
+        shown = 0
         for name, builder in scenario.CANON.items():
             s = builder()
-            planes = [p for p, ok in (
-                ("sim", scenario.sim_supported(s)),
-                ("live", scenario.live_supported(s)),
-                ("streaming", scenario.streaming_supported(s)),
-            ) if ok]
+            if args.family and s.family != args.family:
+                continue
+            # --plane filters the listing only when given explicitly
+            # (the run-path default of sim would otherwise hide
+            # live/streaming-only canon from a bare --list).
+            if args.plane and not supported[args.plane](s):
+                continue
+            planes = [p for p, ok_fn in supported.items() if ok_fn(s)]
             print(f"{name:<26} {'+'.join(planes):<10} {s.description}")
+            shown += 1
+        if shown == 0:
+            print("# no canon scenarios match the filter", file=sys.stderr)
         return 0
 
     if args.replay:
@@ -125,13 +142,17 @@ def main(argv: List[str] | None = None) -> int:
         with open(path) as f:
             specs.append(scenario.ScenarioSpec.from_json(f.read()))
     specs.extend(scenario.build_all(args.names or None))
+    if args.family:
+        specs = [s for s in specs if s.family == args.family]
+        if not specs:
+            ap.error(f"no selected scenario has family {args.family!r}")
 
     if args.save_trace and len(specs) != 1:
         ap.error("--save-trace takes exactly one scenario")
-    if args.plane != "sim" and (args.save_trace or args.replay):
+    if plane != "sim" and (args.save_trace or args.replay):
         ap.error("--save-trace/--replay are sim-plane features")
 
-    if args.plane == "live" and not args.names and not args.spec:
+    if plane == "live" and not args.names and not args.spec:
         # Default canon sweep: keep only what the live plane can lower
         # (attack waves and multitopic are sim-plane subsystems).
         skipped = [s.name for s in specs if not scenario.live_supported(s)]
@@ -139,7 +160,7 @@ def main(argv: List[str] | None = None) -> int:
         if skipped:
             print(f"# live plane: skipping unsupported canon: "
                   f"{', '.join(skipped)}", file=sys.stderr)
-    if args.plane == "sim" and not args.names and not args.spec:
+    if plane == "sim" and not args.names and not args.spec:
         # Mirror filter: live-only and streaming-only canon (root failover,
         # socket partition heal, serving-plane streams) have no device
         # lowering and are skipped from the sim sweep.
@@ -148,7 +169,7 @@ def main(argv: List[str] | None = None) -> int:
         if skipped:
             print(f"# sim plane: skipping live/streaming-only canon: "
                   f"{', '.join(skipped)}", file=sys.stderr)
-    if args.plane == "streaming" and not args.names and not args.spec:
+    if plane == "streaming" and not args.names and not args.spec:
         # Streaming sweep: only what the serving plane can replay.
         skipped = [s.name for s in specs
                    if not scenario.streaming_supported(s)]
@@ -160,7 +181,7 @@ def main(argv: List[str] | None = None) -> int:
     results = []
     for spec in specs:
         t0 = time.time()
-        if args.plane == "live":
+        if plane == "live":
             try:
                 res = scenario.run_live_scenario(
                     spec,
@@ -171,7 +192,7 @@ def main(argv: List[str] | None = None) -> int:
             except scenario.LivePlaneError as e:
                 print(f"error: {e}", file=sys.stderr)
                 return 2
-        elif args.plane == "streaming":
+        elif plane == "streaming":
             try:
                 res = scenario.run_streaming_scenario(spec)
             except scenario.StreamingPlaneError as e:
@@ -188,9 +209,9 @@ def main(argv: List[str] | None = None) -> int:
     if args.json:
         print(json.dumps(
             [dict(res.verdict.to_dict(), family=res.spec.family,
-                  plane=args.plane,
+                  plane=plane,
                   n_publishes=(res.compiled.n_publishes
-                               if args.plane == "sim"
+                               if plane == "sim"
                                else res.n_publishes),
                   seconds=res.seconds)
              for res in results],
