@@ -1062,6 +1062,144 @@ def streaming_child_main() -> None:
             f"forged message propagated (topic {topic} slot {slot})"
     assert len(engine.invalid_published) == N_FORGED
 
+    # ---- faulted: crash/restore cycles over the SAME compiled rollout ----
+    # A fresh engine+ring pair (fresh window budget) over the same model:
+    # the shared rollout cache means warmup here compiles nothing, and the
+    # compiled_once assertion below covers warmup + every crash + restore.
+    import shutil
+    import tempfile
+
+    log("faulted: crash/restore cycles (snapshot_every=1)")
+    ckpt_dir = tempfile.mkdtemp(prefix="bench-stream-ckpt-")
+    ckpt_path = os.path.join(ckpt_dir, "engine.ckpt")
+    n_cycles = 5
+    per_cycle = 16
+    fring = IngestRing(capacity=cfg["capacity"], policy="block")
+    feng = StreamingEngine(
+        model, fring, chunk_steps=cfg["chunk_steps"],
+        pub_width=cfg["pub_width"],
+        completion_frac=cfg["completion_frac"], seed=1,
+        snapshot_path=ckpt_path, snapshot_every=1,
+    )
+    feng.warmup()
+    recoveries = []
+    pushed_valid = 0
+    snap_s = 0.0
+    for cyc in range(n_cycles):
+        for i in range(per_cycle):
+            ok = fring.push(
+                topic=i % 2,
+                payload=b"faulted c%d i%d" % (cyc, i),
+                publisher=int(rng.integers(n_peers)), valid=True,
+                timeout=30.0,
+            )
+            pushed_valid += int(ok)
+        feng.run_chunk()   # snapshot_every=1 checkpoints at this boundary
+        # Kill the engine: the replacement pair warms (no compile — shared
+        # rollout) and restores from the durable snapshot.
+        t_crash = time.perf_counter()
+        snap_s += feng.snapshot_seconds
+        fring = IngestRing(capacity=cfg["capacity"], policy="block")
+        feng = StreamingEngine(
+            model, fring, chunk_steps=cfg["chunk_steps"],
+            pub_width=cfg["pub_width"],
+            completion_frac=cfg["completion_frac"], seed=2 + cyc,
+            snapshot_path=ckpt_path, snapshot_every=1,
+        )
+        feng.warmup()
+        feng.restore()
+        recoveries.append(time.perf_counter() - t_crash)
+    feng.run_until_drained(max_chunks=64)
+    snap_s += feng.snapshot_seconds
+    shutil.rmtree(ckpt_dir, ignore_errors=True)
+    rq = quantiles(recoveries)
+    lost = pushed_valid - feng.completed
+    log(f"faulted: {n_cycles} crash cycles  recovery p50 "
+        f"{rq['p50']*1e3:.1f}ms p99 {rq['p99']*1e3:.1f}ms  "
+        f"completed {feng.completed}/{pushed_valid}  lost {lost}  "
+        f"dups {feng.duplicate_completions}")
+    assert lost == 0, f"lost {lost} messages across crash/restore"
+    assert feng.duplicate_completions == 0, \
+        f"{feng.duplicate_completions} duplicate deliveries after replay"
+    assert feng.compile_cache_size() == 1, \
+        "crash-restart path recompiled the resident chunk"
+    faulted = {
+        "crash_cycles": n_cycles,
+        "pushed_valid": pushed_valid,
+        "completed": feng.completed,
+        "lost_after_restart": lost,
+        "duplicate_completions": feng.duplicate_completions,
+        "replay_deduped": feng.replay_deduped,
+        "recovery_p50_s": round(rq["p50"], 6),
+        "recovery_p99_s": round(rq["p99"], 6),
+        "snapshot_overhead_s": round(snap_s, 4),
+        "note": (
+            "pre-validated pushes (inline crypto is measured by the clean "
+            "sections); recovery = fresh engine warmup + restore, no "
+            "recompile via the shared rollout cache"
+        ),
+    }
+
+    # ---- degraded: watchdog tier ladder under overload -------------------
+    # Its own smaller model (separate compiled program, deliberately outside
+    # the compiled_once assertions) so the overload feed is cheap.
+    from go_libp2p_pubsub_tpu.serve import Watchdog
+
+    log("degraded: overload ladder (shed_priority -> drop_oldest)")
+    dmodel = MultiTopicGossipSub(
+        n_topics=2, n_peers=64, n_slots=8, conn_degree=4,
+        msg_window=64, heartbeat_steps=4,
+    )
+    dring = IngestRing(capacity=32, policy="reject")
+    deng = StreamingEngine(dmodel, dring, chunk_steps=4, pub_width=2,
+                           completion_frac=0.99, seed=0)
+    deng.warmup()
+    wd = Watchdog(
+        deng, dring, chunk_stall_s=3600.0,
+        high_watermark=24, low_watermark=8,
+        topic_priority=[0, 1],   # topic 0 is sheddable
+    )
+    tiers_seen = [wd.tier_name]
+    t0 = time.perf_counter()
+    dseq = 0
+    for step in range(10):
+        # Offered load (24/chunk) far above drain rate (8/chunk) for the
+        # first half, then silence so the ladder walks back down.
+        if step < 5:
+            for i in range(24):
+                dring.push(topic=i % 2, payload=b"degraded %d" % dseq,
+                           publisher=int(rng.integers(64)), valid=True)
+                dseq += 1
+        deng.run_chunk()
+        wd.note_chunk()
+        wd.poll()
+        if wd.tier_name != tiers_seen[-1]:
+            tiers_seen.append(wd.tier_name)
+    deng.run_until_drained(max_chunks=32)
+    degraded_elapsed = time.perf_counter() - t0
+    dacct = dring.accounting()
+    degraded_rate = deng.completed * 64.0 / degraded_elapsed
+    log(f"degraded: tiers {'->'.join(tiers_seen)}  "
+        f"shed {dacct['shed_priority']}  dropped {dacct['dropped_oldest']}  "
+        f"rejected {dacct['rejected']}  "
+        f"completed {deng.completed}  {degraded_rate:,.0f} msgs/s")
+    assert "shed_priority" in tiers_seen and "drop_oldest" in tiers_seen, \
+        f"overload never escalated the ladder (saw {tiers_seen})"
+    assert tiers_seen[-1] == "normal", \
+        f"ladder never de-escalated (ended {tiers_seen[-1]})"
+    assert dacct["silent_drops"] == 0, \
+        f"degradation leaked {dacct['silent_drops']} silent drops"
+    degraded = {
+        "tiers_seen": tiers_seen,
+        "shed_priority": dacct["shed_priority"],
+        "dropped_oldest": dacct["dropped_oldest"],
+        "rejected_pushes": dacct["rejected"],
+        "silent_drops": dacct["silent_drops"],
+        "completed": deng.completed,
+        "degraded_msgs_per_sec": round(degraded_rate, 1),
+        "elapsed_s": round(degraded_elapsed, 3),
+    }
+
     cache = engine.compile_cache_size()
     record = {
         "metric": "streaming_validated_msgs_per_sec",
@@ -1090,6 +1228,8 @@ def streaming_child_main() -> None:
         "constant": sections["constant"],
         "burst": sections["burst"],
         "hot": sections["hot"],
+        "faulted": faulted,
+        "degraded": degraded,
     }
     assert record["compile"]["compiled_once"], \
         f"resident chunk recompiled (cache_size={cache})"

@@ -585,6 +585,80 @@ def streaming_burst_overload() -> ScenarioSpec:
     )
 
 
+def streaming_engine_crash_recovery() -> ScenarioSpec:
+    """STREAMING-ONLY chaos: the resident engine is killed after its second
+    loaded chunk — host state, ring and all — and must come back from its
+    last durable snapshot through the watchdog restart path.  The crash
+    SLOs are the r14 contract: recovery bounded, ZERO accepted messages
+    lost, ZERO duplicate deliveries (the replayed ring messages pass the
+    engine's content-hash dedup), and the conservation ledger still exact
+    across the checkpoint/restore cycle."""
+    return ScenarioSpec(
+        name="streaming_engine_crash_recovery",
+        family="multitopic",
+        n_steps=32,
+        seed=101,
+        model=dict(_STREAM_MESH),
+        workloads=[
+            Workload(kind="constant", topic=0, start=0, stop=32, every=2),
+            Workload(kind="constant", topic=1, start=1, stop=32, every=2),
+        ],
+        streaming={
+            "streaming_only": True,
+            "chunk_steps": 8,
+            "capacity": 16,
+            "policy": "block",
+            "snapshot_every": 1,
+            "crash_at_chunk": 2,
+        },
+        slo=SLO(
+            min_delivery_frac=0.97,
+            max_queue_depth=16,
+            max_silent_drops=0,
+            max_recovery_s=60.0,         # generous: CPU restore + replay
+            max_lost_after_restart=0,
+            max_duplicate_deliveries=0,
+        ),
+        description="Engine killed after chunk 2; snapshot restore must "
+                    "lose nothing and deliver nothing twice.",
+    )
+
+
+def streaming_verifier_crash() -> ScenarioSpec:
+    """STREAMING-ONLY chaos: the validation pipeline dies with a batch in
+    flight after the second chunk's submissions.  The producer resubmits
+    its retry window at-least-once — including the previous, already
+    admitted group — and the engine's content-hash dedup must keep
+    delivery exactly-once (zero duplicates, zero losses, ledger exact)."""
+    return ScenarioSpec(
+        name="streaming_verifier_crash",
+        family="multitopic",
+        n_steps=32,
+        seed=103,
+        model=dict(_STREAM_MESH),
+        workloads=[
+            Workload(kind="constant", topic=0, start=0, stop=32, every=2),
+            Workload(kind="constant", topic=1, start=1, stop=32, every=2),
+        ],
+        streaming={
+            "streaming_only": True,
+            "chunk_steps": 8,
+            "capacity": 16,
+            "policy": "block",
+            "verifier_crash_at_chunk": 2,
+        },
+        slo=SLO(
+            min_delivery_frac=0.97,
+            max_queue_depth=16,
+            max_silent_drops=0,
+            max_lost_after_restart=0,
+            max_duplicate_deliveries=0,
+        ),
+        description="Verifier pool dies mid-batch; at-least-once resubmit "
+                    "+ content-hash dedup = exactly-once delivery.",
+    )
+
+
 CANON: Dict[str, Callable[[], ScenarioSpec]] = {
     "steady_state": steady_state,
     "flash_crowd": flash_crowd,
@@ -607,6 +681,8 @@ CANON: Dict[str, Callable[[], ScenarioSpec]] = {
     "live_partition_heal": live_partition_heal,
     "streaming_steady": streaming_steady,
     "streaming_burst_overload": streaming_burst_overload,
+    "streaming_engine_crash_recovery": streaming_engine_crash_recovery,
+    "streaming_verifier_crash": streaming_verifier_crash,
 }
 
 

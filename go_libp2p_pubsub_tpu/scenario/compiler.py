@@ -691,6 +691,10 @@ class StreamingPlan:
     policy: str
     pub_width: int
     completion_frac: float
+    # Chaos lowering (r14): validated fault stages for the runner to
+    # inject at chunk boundaries, and the engine's snapshot period.
+    faults: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    snapshot_every: int = 0
 
 
 def compile_streaming_plan(spec: ScenarioSpec) -> StreamingPlan:
@@ -723,6 +727,19 @@ def compile_streaming_plan(spec: ScenarioSpec) -> StreamingPlan:
     # Default pub_width lets ONE chunk drain a full ring: ceil(cap / steps).
     pub_width = int(cfg.get("pub_width", max(1, -(-capacity // chunk_steps))))
     completion_frac = float(cfg.get("completion_frac", 0.99))
+    faults = _lower_streaming_faults(cfg, T, chunk_steps)
+    # A staged crash needs a snapshot to come back from; default to
+    # every-chunk snapshots so the boundary crash loses nothing.
+    snapshot_every = int(
+        cfg.get("snapshot_every", 1 if "crash_at_chunk" in faults else 0)
+    )
+    if snapshot_every < 0:
+        raise ValueError("snapshot_every must be >= 0")
+    if "crash_at_chunk" in faults and snapshot_every == 0:
+        raise ValueError(
+            "crash_at_chunk needs snapshot_every >= 1 (nothing to restore "
+            "from otherwise)"
+        )
 
     timeline: List[List[tuple]] = [[] for _ in range(T)]
     for wi, w in enumerate(spec.workloads):
@@ -741,6 +758,17 @@ def compile_streaming_plan(spec: ScenarioSpec) -> StreamingPlan:
                     raise ValueError(f"publisher {src} out of range [0, {n})")
                 timeline[t].append((w.topic, src, bool(w.valid)))
 
+    if "producer_stall" in faults:
+        # Stall-then-flood: the producer is wedged through the window, and
+        # everything it would have published lands in one step at wake-up.
+        stall = faults["producer_stall"]
+        wake = stall["start"] + stall["steps"]
+        deferred: List[tuple] = []
+        for t in range(stall["start"], wake):
+            deferred.extend(timeline[t])
+            timeline[t] = []
+        timeline[wake] = deferred + timeline[wake]
+
     return StreamingPlan(
         spec=spec,
         timeline=timeline,
@@ -750,4 +778,52 @@ def compile_streaming_plan(spec: ScenarioSpec) -> StreamingPlan:
         policy=policy,
         pub_width=pub_width,
         completion_frac=completion_frac,
+        faults=faults,
+        snapshot_every=snapshot_every,
     )
+
+
+def _lower_streaming_faults(
+    cfg: Dict[str, Any], n_steps: int, chunk_steps: int
+) -> Dict[str, Any]:
+    """Validate the streaming dict's fault keys into StreamingPlan.faults.
+
+    Chunk-indexed faults fire after that many TRAFFIC chunks (1-based, so
+    ``crash_at_chunk=1`` kills the engine right after its first loaded
+    chunk); they must land inside the campaign's chunk count.  Unknown
+    behavior is rejected loudly, matching the sim compiler's posture."""
+    n_chunks = -(-n_steps // chunk_steps)
+    faults: Dict[str, Any] = {}
+    for key in ("crash_at_chunk", "verifier_crash_at_chunk"):
+        if cfg.get(key) is not None:
+            at = int(cfg[key])
+            if not (1 <= at <= n_chunks):
+                raise ValueError(
+                    f"{key}={at} outside the campaign's chunk range "
+                    f"[1, {n_chunks}]"
+                )
+            faults[key] = at
+    if cfg.get("producer_stall") is not None:
+        st = dict(cfg["producer_stall"])
+        start, steps = int(st.get("start", 0)), int(st.get("steps", 0))
+        if steps < 1 or start < 0:
+            raise ValueError("producer_stall needs start >= 0, steps >= 1")
+        if start + steps >= n_steps:
+            raise ValueError(
+                f"producer_stall window [{start}, {start + steps}) must end "
+                f"before the campaign's last step ({n_steps - 1}) so the "
+                "deferred flood still publishes"
+            )
+        faults["producer_stall"] = {"start": start, "steps": steps}
+    if cfg.get("clock_skew") is not None:
+        sk = dict(cfg["clock_skew"])
+        at = int(sk.get("at_chunk", 1))
+        if not (1 <= at <= n_chunks):
+            raise ValueError(
+                f"clock_skew.at_chunk={at} outside the campaign's chunk "
+                f"range [1, {n_chunks}]"
+            )
+        faults["clock_skew"] = {
+            "at_chunk": at, "skew_s": float(sk.get("skew_s", 0.0)),
+        }
+    return faults

@@ -227,6 +227,21 @@ def evaluate(
             "silent_drops", "max", slo.max_silent_drops,
             _streaming_channel("silent_drops", "max_silent_drops")[-1],
         ))
+    # Crash-safety criteria (r14): the streaming runner emits recovery_s /
+    # lost_after_restart on EVERY run (zeros when no fault fired), so these
+    # grade real measurements, never a vacuous pass.
+    if slo.max_recovery_s is not None:
+        crits.append(_crit(
+            "recovery_s", "max", slo.max_recovery_s,
+            _streaming_channel("recovery_s", "max_recovery_s")[-1],
+        ))
+    if slo.max_lost_after_restart is not None:
+        crits.append(_crit(
+            "lost_after_restart", "max", slo.max_lost_after_restart,
+            _streaming_channel(
+                "lost_after_restart", "max_lost_after_restart"
+            )[-1],
+        ))
 
     return Verdict(
         scenario=spec.name,

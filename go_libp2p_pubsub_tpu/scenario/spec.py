@@ -263,6 +263,17 @@ class SLO:
     max_queue_depth: Optional[int] = None
     max_ingest_latency_s: Optional[float] = None
     max_silent_drops: Optional[int] = None
+    # Streaming crash-safety criteria (r14, graded from the runner's
+    # ``recovery_s`` / ``lost_after_restart`` channels — emitted on every
+    # streaming run, zeros when no fault fired, so the SLO never passes
+    # vacuously).  ``max_recovery_s`` bounds crash→resumed wall time;
+    # ``max_lost_after_restart`` is the exactly-once floor: accepted valid
+    # messages neither delivered, in flight, nor attributed to a named shed
+    # counter after the run (0 = no accepted message vanished in the
+    # crash).  ``max_duplicate_deliveries`` (above) reads the engine's
+    # content-hash duplicate counter on this plane.
+    max_recovery_s: Optional[float] = None
+    max_lost_after_restart: Optional[int] = None
 
 
 @dataclass
@@ -292,6 +303,24 @@ class ScenarioSpec:
     # "capacity": int, "policy": str, "pub_width": int,
     # "completion_frac": float}.  Same plain-dict shape as ``live`` so the
     # JSON round-trip stays exact for specs that never stream.
+    #
+    # Fault-injection keys (r14 chaos, all optional, lowered by
+    # compiler.compile_streaming_plan onto StreamingPlan.faults):
+    #   "snapshot_every": int       — engine auto-snapshot period in chunks
+    #                                 (defaults to 1 when a crash is staged)
+    #   "crash_at_chunk": int       — kill the engine+ring after that many
+    #                                 traffic chunks; recovery = fresh engine
+    #                                 over an equal model + restore()
+    #   "verifier_crash_at_chunk": int — drop the validation pipeline with a
+    #                                 batch in flight; the producer resubmits
+    #                                 its retry window (at-least-once), the
+    #                                 engine's dedup keeps delivery
+    #                                 exactly-once
+    #   "producer_stall": {"start": int, "steps": int} — publishes scheduled
+    #                                 in the window are deferred to its end
+    #                                 (stall-then-flood)
+    #   "clock_skew": {"at_chunk": int, "skew_s": float} — step the host
+    #                                 clock the latency stamps read
     streaming: Optional[Dict[str, Any]] = None
     slo: SLO = field(default_factory=SLO)
     description: str = ""

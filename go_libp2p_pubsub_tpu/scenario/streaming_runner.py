@@ -15,13 +15,37 @@ series from the ring, exact ingest→delivery latencies from the engine's
 host clocks (quantized to chunk boundaries — see ``serve.engine``), and
 the ring's conservation ledger (``silent_drops`` must be 0 under every
 policy).  ``slo.evaluate`` reads these through the streaming SLO channels.
+
+Chaos (r14): the plan's fault stages are injected at chunk boundaries,
+deterministically —
+
+- ``crash_at_chunk``: the engine AND ring are discarded (honest host-state
+  loss) and replaced by a fresh pair over an equal model; recovery goes
+  through ``Watchdog.restart_engine`` → ``StreamingEngine.restore()``,
+  which reuses the shared compiled rollout (no recompile) and replays the
+  snapshot's accepted-but-undelivered ring messages;
+- ``verifier_crash_at_chunk``: the validation pipeline dies with a batch
+  in flight (``drop_pending``); the producer resubmits its retry window —
+  the last two chunk groups, at-least-once — and the engine's content-hash
+  dedup keeps delivery exactly-once;
+- ``producer_stall``: lowered into the timeline by the compiler
+  (stall-then-flood);
+- ``clock_skew``: the shared host clock steps by ``skew_s`` mid-run; the
+  engine clamps-and-counts any negative ingest→delivery interval.
+
+Every streaming run emits ``recovery_s`` / ``lost_after_restart`` /
+``duplicate_deliveries`` channels (zeros when unfaulted) so the crash SLOs
+always grade a real measurement.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import os
+import shutil
+import tempfile
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -49,6 +73,20 @@ def streaming_supported(spec: ScenarioSpec) -> bool:
     )
 
 
+class _SkewClock:
+    """Monotonic host clock with an injectable offset — the clock_skew
+    fault's lever.  Shared by the ring (ingest stamps) and the engine
+    (delivery stamps) so a skew step lands mid-measurement, exactly like a
+    host NTP correction would."""
+
+    def __init__(self, base=time.monotonic) -> None:
+        self._base = base
+        self.offset = 0.0
+
+    def __call__(self) -> float:
+        return self._base() + self.offset
+
+
 @dataclasses.dataclass
 class StreamingScenarioResult:
     """One streaming campaign: plan + verdict + host-truth record."""
@@ -71,51 +109,85 @@ def run_streaming_scenario(
     """Execute ``spec`` on the streaming plane and grade its SLOs."""
     from ..crypto import native
     from ..crypto.pipeline import ValidationPipeline, sign_envelope
-    from ..serve import IngestRing, StreamingEngine
+    from ..serve import IngestRing, StreamingEngine, Watchdog
 
     t0 = time.monotonic()
     plan = compile_streaming_plan(spec)
+    faults = plan.faults
     try:
         model = build_model(spec)
     except Exception as e:  # model kwargs are spec data, not code
         raise StreamingPlaneError(f"model build failed: {e}") from e
 
-    ring = IngestRing(capacity=plan.capacity, policy=plan.policy)
-    engine = StreamingEngine(
-        model,
-        ring,
-        chunk_steps=plan.chunk_steps,
-        pub_width=plan.pub_width,
-        completion_frac=plan.completion_frac,
-        seed=spec.seed,
-    )
+    clock = _SkewClock()
+    ckpt_dir: Optional[str] = None
+    ckpt_path: Optional[str] = None
+    if plan.snapshot_every > 0:
+        ckpt_dir = tempfile.mkdtemp(prefix="stream-ckpt-")
+        ckpt_path = os.path.join(ckpt_dir, "engine.ckpt")
+
+    def _mk_pair(seed: int):
+        ring = IngestRing(
+            capacity=plan.capacity, policy=plan.policy, clock=clock
+        )
+        engine = StreamingEngine(
+            model,
+            ring,
+            chunk_steps=plan.chunk_steps,
+            pub_width=plan.pub_width,
+            completion_frac=plan.completion_frac,
+            seed=seed,
+            clock=clock,
+            snapshot_path=ckpt_path,
+            snapshot_every=plan.snapshot_every,
+        )
+        return ring, engine
+
+    ring, engine = _mk_pair(spec.seed)
     try:
         engine.warmup()
     except Exception as e:
         raise StreamingPlaneError(f"engine warmup failed: {e}") from e
 
+    watchdog: Optional[Watchdog] = None
+    if "crash_at_chunk" in faults:
+        # Supervision is exercised through its public restart path; the
+        # stall threshold is irrelevant under injected (not timed) crashes.
+        watchdog = Watchdog(
+            engine, ring, checkpoint_path=ckpt_path,
+            chunk_stall_s=3600.0, clock=clock,
+        )
+
     # Crypto stage ahead of enqueue: the verdict callback is the ONLY path
     # into the ring, so an envelope that fails batch verification is pushed
     # valid=False and the device's publish gate keeps it out of every mesh.
+    # The ring is read through a holder because a staged crash replaces it.
     backend = (
         "native" if (signer_backend == "auto" and native.available())
         else ("python" if signer_backend == "auto" else signer_backend)
     )
+    holder = {"ring": ring}
     rejected_pushes = 0
+    admitted_valid = 0
 
     def _admit(env, ok, ctx):
-        nonlocal rejected_pushes
+        nonlocal rejected_pushes, admitted_valid
         topic, src = ctx
-        admitted = ring.push(
+        admitted = holder["ring"].push(
             topic=topic, payload=env.payload, publisher=src,
             valid=ok, timeout=5.0,
         )
         if not admitted:
             rejected_pushes += 1
+        elif ok:
+            admitted_valid += 1
 
-    pipe = ValidationPipeline(
-        backend=backend, flush_threshold=4096, on_verdict_ctx=_admit
-    )
+    def _mk_pipe():
+        return ValidationPipeline(
+            backend=backend, flush_threshold=4096, on_verdict_ctx=_admit
+        )
+
+    pipe = _mk_pipe()
 
     # Replay the timeline in chunk-sized groups: submit that group's
     # publishes through the crypto stage, flush (which enqueues), run one
@@ -125,10 +197,20 @@ def run_streaming_scenario(
     seed_bytes = spec.seed.to_bytes(8, "little")
     depth_series: List[int] = []
     frac_series: List[float] = []
+    recovery_s_list: List[float] = []
+    replayed_total = 0
+    pipeline_restarts = 0
     seqno = 0
     n_valid_published = 0
+    chunk_index = 0
+    # Producer retry window for the verifier-crash fault: the last two
+    # groups' (envelope, ctx) pairs, resubmitted at-least-once after a
+    # pipeline death (drop_pending loses in-flight ctx by contract, so the
+    # producer keeps its own copies — as a real at-least-once client would).
+    retry_window: List[List[Tuple[Any, Tuple[int, int]]]] = []
     T = spec.n_steps
     for base in range(0, T, plan.chunk_steps):
+        group: List[Tuple[Any, Tuple[int, int]]] = []
         for t in range(base, min(base + plan.chunk_steps, T)):
             for topic, src, valid in plan.timeline[t]:
                 env = sign_envelope(
@@ -141,12 +223,52 @@ def run_streaming_scenario(
                         env, signature=b"\x00" * 64
                     )
                 pipe.submit(env, ctx=(topic, src))
+                group.append((env, (topic, src)))
                 seqno += 1
                 if valid:
                     n_valid_published += 1
+        retry_window.append(group)
+        del retry_window[:-2]
+        if faults.get("verifier_crash_at_chunk") == chunk_index + 1:
+            # The verifier pool dies with this group's batch in flight.
+            # Restart = fresh pipeline; the producer replays its whole
+            # retry window (at-least-once — the previous group was already
+            # verified and admitted, so its copies exercise the engine's
+            # exactly-once dedup).
+            pipe.drop_pending()
+            pipe = _mk_pipe()
+            pipeline_restarts += 1
+            for g in retry_window:
+                for env, ctx in g:
+                    pipe.submit(env, ctx=ctx)
         pipe.flush()
-        depth_series.append(ring.depth)
+        depth_series.append(holder["ring"].depth)
         engine.run_chunk()
+        chunk_index += 1
+        if faults.get("crash_at_chunk") == chunk_index:
+            # Honest host-state loss: engine AND ring discarded.  Recovery
+            # = fresh pair over an equal model (warmup reuses the shared
+            # compiled chunk — no recompile) + watchdog-driven restore.
+            t_crash = time.monotonic()
+            ring, engine = _mk_pair(spec.seed + 1)
+            try:
+                engine.warmup()
+            except Exception as e:
+                raise StreamingPlaneError(
+                    f"post-crash warmup failed: {e}"
+                ) from e
+            assert watchdog is not None
+            watchdog.engine = engine
+            watchdog.ring = ring
+            info = watchdog.restart_engine(
+                f"injected engine crash after chunk {chunk_index}"
+            )
+            replayed_total += info["replayed"]
+            recovery_s_list.append(time.monotonic() - t_crash)
+            holder["ring"] = ring
+        skew = faults.get("clock_skew")
+        if skew is not None and skew["at_chunk"] == chunk_index:
+            clock.offset += skew["skew_s"]
         frac_series.append(
             engine.completed / max(1, len(engine.publish_log))
         )
@@ -155,6 +277,19 @@ def run_streaming_scenario(
     acct = ring.accounting()
     lats = engine.latencies_s
     q = engine.latency_quantiles()
+
+    # Exactly-once floor: every admitted valid message must end the run
+    # delivered, deduplicated, in flight, still queued, or attributed to a
+    # named shed counter.  The residual is what the crash actually LOST.
+    lost_after_restart = (
+        admitted_valid
+        - engine.completed
+        - engine.replay_deduped
+        - engine.evicted
+        - len(engine.pending)
+        - acct["dropped_oldest_valid"]
+        - acct["valid_in_queue"]
+    )
 
     # Host-truth flight record, shaped like the other planes' (leading time
     # axis, scalars as length-1 series) so slo.evaluate reads uniformly.
@@ -171,8 +306,17 @@ def run_streaming_scenario(
         "delivery_frac": np.asarray(
             frac_series + [delivery_frac], np.float64
         ),
+        "recovery_s": np.asarray(
+            [max(recovery_s_list) if recovery_s_list else 0.0], np.float64
+        ),
+        "lost_after_restart": np.asarray([lost_after_restart], np.int64),
+        "duplicate_deliveries": np.asarray(
+            [engine.duplicate_completions], np.int64
+        ),
     }
     verdict = slo_mod.evaluate(spec, record, plan.n_publishes)
+    if ckpt_dir is not None:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
     return StreamingScenarioResult(
         spec=spec,
         plan=plan,
@@ -188,6 +332,18 @@ def run_streaming_scenario(
             "evicted": engine.evicted,
             "valid_published": n_valid_published,
             "rejected_pushes": rejected_pushes,
+            "admitted_valid": admitted_valid,
+            "restores": engine.restores,
+            "replayed": replayed_total,
+            "replay_deduped": engine.replay_deduped,
+            "duplicate_completions": engine.duplicate_completions,
+            "clock_anomalies": engine.clock_anomalies,
+            "snapshots_taken": engine.snapshots_taken,
+            "pipeline_restarts": pipeline_restarts,
+            "watchdog_restarts": (
+                watchdog.engine_restarts if watchdog is not None else 0
+            ),
+            "recovery_s_list": list(recovery_s_list),
             "pipeline": dict(pipe.stats),
         },
         seconds=time.monotonic() - t0,

@@ -6,10 +6,16 @@ an unbounded publish stream flowing through a host-side ring buffer
 (:mod:`.ingest`) into a device-resident chunked rollout (:mod:`.engine`)
 whose compiled program never changes shape, so the stream rides one XLA
 compilation for its whole lifetime.
+
+Crash safety lives in the same package: the engine writes atomic durable
+snapshots and restores from them without recompiling (:mod:`.engine`),
+supervised by a fake-clock-testable watchdog that restarts wedged engines
+and walks explicit degradation tiers under overload (:mod:`.watchdog`).
 """
 
-from .engine import PendingMessage, StreamingEngine
+from .engine import PendingMessage, StreamingEngine, content_hash
 from .ingest import BACKPRESSURE_POLICIES, IngestItem, IngestRing
+from .watchdog import TIER_NAMES, Watchdog
 
 __all__ = [
     "BACKPRESSURE_POLICIES",
@@ -17,4 +23,7 @@ __all__ = [
     "IngestRing",
     "PendingMessage",
     "StreamingEngine",
+    "TIER_NAMES",
+    "Watchdog",
+    "content_hash",
 ]

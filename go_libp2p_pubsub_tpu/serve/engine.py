@@ -21,20 +21,64 @@ documented property of the measurement, not an approximation inside it.
 The flight-recorder tail (the last round of every in-scan telemetry
 channel, including the latency histogram) is carried across chunks so a
 scrape mid-stream sees current telemetry without any extra device work.
+
+Crash safety (r14): ``snapshot()`` writes a durable checkpoint — device
+state + flight tail as the array payload, every piece of host bookkeeping
+(slot cursors, pending/publish logs, dedup hashes) plus the ingest ring's
+buffer and conservation ledger as JSON meta — through the same atomic
+write→fsync→rename path as ``utils/checkpoint``.  ``restore()`` on a
+warmed engine resumes from the last chunk boundary WITHOUT recompiling:
+the resident program lives in a module-level cache keyed on the model's
+value semantics, so a freshly constructed engine over an equal model (the
+crash-restart path) reuses the already-compiled chunk.  Replayed
+accepted-but-undelivered ring messages are deduplicated by content hash
+(topic ‖ publisher ‖ payload) at publish time, making delivery
+exactly-once across a crash even when producers resubmit at-least-once.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import time
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
 from ..models.multitopic import MultiTopicGossipSub
 from ..ops import schedule as sched
+from ..utils import checkpoint as ckpt
 from .ingest import IngestItem, IngestRing
+
+# The resident program per model VALUE (models define __eq__/__hash__ over
+# their config).  Keyed here — not per-engine — so the crash-restart path
+# (fresh engine over an equal model) shares the compiled chunk instead of
+# paying a recompile.  Engines sharing a model must use identical
+# (chunk_steps, pub_width) for compile_cache_size() to stay 1.
+_ROLLOUT_CACHE: Dict[MultiTopicGossipSub, object] = {}
+
+
+def _resident_rollout(model: MultiTopicGossipSub):
+    fn = _ROLLOUT_CACHE.get(model)
+    if fn is None:
+        fn = jax.jit(
+            lambda st, ev: model.rollout_events(st, ev, record=True),
+            donate_argnums=(0,),
+        )
+        _ROLLOUT_CACHE[model] = fn
+    return fn
+
+
+def content_hash(topic: int, publisher: int, payload: bytes) -> str:
+    """Stable identity of a publish for exactly-once dedup (hex).  Keyed on
+    content, not ring seq — a resubmitted message gets a fresh seq but the
+    same hash."""
+    h = hashlib.sha256()
+    h.update(int(topic).to_bytes(4, "little"))
+    h.update(int(publisher).to_bytes(8, "little"))
+    h.update(payload)
+    return h.hexdigest()[:32]
 
 
 @dataclasses.dataclass
@@ -48,6 +92,7 @@ class PendingMessage:
     t_ingest: float       # host clock at ring push
     t_publish: float      # host clock when its chunk was dispatched
     step_published: int   # global device step of its publish row
+    chash: str = ""       # content hash (exactly-once identity)
 
 
 class StreamingEngine:
@@ -70,11 +115,17 @@ class StreamingEngine:
         seed: int = 0,
         metrics=None,
         clock=time.monotonic,
+        snapshot_path: Optional[str] = None,
+        snapshot_every: int = 0,
     ) -> None:
         if chunk_steps < 1 or pub_width < 1:
             raise ValueError("chunk_steps and pub_width must be >= 1")
         if not (0.0 < completion_frac <= 1.0):
             raise ValueError("completion_frac must be in (0, 1]")
+        if snapshot_every < 0:
+            raise ValueError("snapshot_every must be >= 0")
+        if snapshot_every > 0 and snapshot_path is None:
+            raise ValueError("snapshot_every needs a snapshot_path")
         self.model = model
         self.ring = ring
         self.chunk_steps = chunk_steps
@@ -82,14 +133,13 @@ class StreamingEngine:
         self.completion_frac = completion_frac
         self.metrics = metrics
         self._clock = clock
+        self.snapshot_path = snapshot_path
+        self.snapshot_every = snapshot_every
         self.state = model.init(seed=seed)
-        # The resident program: donated state in, fixed event shapes.  The
-        # inner rollout_events jit is keyed on the model's value semantics,
-        # so engines over equal configs share both cache layers.
-        self._rollout = jax.jit(
-            lambda st, ev: model.rollout_events(st, ev, record=True),
-            donate_argnums=(0,),
-        )
+        # The resident program: donated state in, fixed event shapes —
+        # shared process-wide per model value (see _ROLLOUT_CACHE), so the
+        # crash-restart path never recompiles.
+        self._rollout = _resident_rollout(model)
         self._next_slot = [0] * model.t          # per-topic cyclic allocator
         self.pending: Dict[Tuple[int, int], PendingMessage] = {}
         self.latencies_s: List[float] = []       # completed, host seconds
@@ -99,6 +149,14 @@ class StreamingEngine:
         self.published = 0
         self.completed = 0
         self.evicted = 0       # window slot recycled before completion
+        self.restores = 0
+        self.replay_deduped = 0        # valid items skipped: already published
+        self.duplicate_completions = 0  # same content completed twice
+        self.clock_anomalies = 0       # negative ingest→delivery intervals
+        self.snapshots_taken = 0
+        self.snapshot_seconds = 0.0    # cumulative wall time in snapshot()
+        self._seen_hashes: set = set()        # every VALID publish, ever
+        self._completed_hashes: set = set()   # every completed content
         self.flight_tail: Dict[str, np.ndarray] = {}
 
     # -- lifecycle ----------------------------------------------------------
@@ -106,8 +164,16 @@ class StreamingEngine:
     def warmup(self) -> None:
         """Run one all-quiet chunk to pay the compile before traffic
         arrives (the serving analog of the bench's compile+warm pass).
-        Advances the device state by ``chunk_steps`` idle rounds."""
-        self._dispatch(self._empty_events())
+        Advances the device state by ``chunk_steps`` idle rounds.
+
+        Warmup chunks never auto-snapshot: on the crash-restart path a
+        fresh engine warms up *before* ``restore()``, and an auto-snapshot
+        here would clobber the very checkpoint it is about to restore."""
+        self._in_warmup = True
+        try:
+            self._dispatch(self._empty_events())
+        finally:
+            self._in_warmup = False
 
     def compile_cache_size(self) -> int:
         """Number of compiled variants of the resident chunk — 1 after
@@ -123,19 +189,33 @@ class StreamingEngine:
         items = self.ring.pop_batch(self.chunk_steps * self.pub_width)
         base_step = self.chunks_run * self.chunk_steps
         t_dispatch = self._clock()
-        for i, item in enumerate(items):
-            row = i % self.chunk_steps
-            col = i // self.chunk_steps
+        cursor = 0
+        for item in items:
+            if item.valid:
+                # Exactly-once gate: a content hash already published (this
+                # incarnation or a restored one) is a producer resubmission
+                # or a replayed duplicate — skip it loudly, never twice.
+                chash = content_hash(item.topic, item.publisher, item.payload)
+                if chash in self._seen_hashes:
+                    self.replay_deduped += 1
+                    if self.metrics is not None:
+                        self.metrics.inc("serve.engine.replay_deduped")
+                    continue
+            row = cursor % self.chunk_steps
+            col = cursor // self.chunk_steps
+            cursor += 1
             slot = self._alloc_slot(item)
             events.pub_topic[row, col] = item.topic
             events.pub_src[row, col] = item.publisher
             events.pub_slot[row, col] = slot
             events.pub_valid[row, col] = item.valid
             if item.valid:
+                self._seen_hashes.add(chash)
                 p = PendingMessage(
                     seq=item.seq, topic=item.topic, slot=slot,
                     publisher=item.publisher, t_ingest=item.t_ingest,
                     t_publish=t_dispatch, step_published=base_step + row,
+                    chash=chash,
                 )
                 self.pending[(item.topic, slot)] = p
                 self.publish_log.append(p)
@@ -152,6 +232,155 @@ class StreamingEngine:
             self.run_chunk()
             n += 1
         return n
+
+    # -- checkpoint ----------------------------------------------------------
+
+    def _model_key(self) -> str:
+        """Config fingerprint stored in checkpoint meta — a sanity check
+        that a snapshot is restored onto an equal model (the array
+        shape/dtype validation in utils.checkpoint does the heavy part)."""
+        m = self.model
+        return (
+            f"multitopic t={m.t} n={m.n} k={m.k} m={m.m} w={m.w} "
+            f"hb={m.heartbeat_steps}"
+        )
+
+    def snapshot(self, path: Optional[str] = None) -> str:
+        """Write a durable checkpoint at the current chunk boundary.
+
+        Array payload: device protocol state + the flight-recorder tail.
+        JSON meta: every piece of host bookkeeping needed to resume —
+        slot cursors, pending + publish logs, dedup hashes, counters —
+        plus the ingest ring's buffer contents and conservation ledger.
+        Atomic via utils.checkpoint (write → fsync → rename), so a crash
+        mid-save never shadows the previous good snapshot."""
+        path = path if path is not None else self.snapshot_path
+        if path is None:
+            raise ValueError("snapshot needs a path (ctor or argument)")
+        if self.chunks_run < 1 or not self.flight_tail:
+            raise RuntimeError(
+                "snapshot() needs a warmed engine (run warmup() first so "
+                "the flight tail has its resident structure)"
+            )
+        t0 = time.monotonic()
+        meta = {
+            "kind": "streaming-engine",
+            "model": self._model_key(),
+            "chunk_steps": self.chunk_steps,
+            "pub_width": self.pub_width,
+            "completion_frac": self.completion_frac,
+            "chunks_run": self.chunks_run,
+            "published": self.published,
+            "completed": self.completed,
+            "evicted": self.evicted,
+            "replay_deduped": self.replay_deduped,
+            "duplicate_completions": self.duplicate_completions,
+            "clock_anomalies": self.clock_anomalies,
+            "next_slot": list(self._next_slot),
+            "publish_log": [dataclasses.asdict(p) for p in self.publish_log],
+            "pending_keys": sorted(
+                [t, s] for (t, s) in self.pending.keys()
+            ),
+            "invalid_published": [
+                [t, s] for (t, s) in self.invalid_published
+            ],
+            "latencies_s": list(self.latencies_s),
+            "seen_hashes": sorted(self._seen_hashes),
+            "completed_hashes": sorted(self._completed_hashes),
+            "ring": self.ring.snapshot(),
+        }
+        ckpt.save(
+            path,
+            {"state": self.state, "flight_tail": dict(self.flight_tail)},
+            meta=meta,
+        )
+        self.snapshots_taken += 1
+        self.snapshot_seconds += time.monotonic() - t0
+        if self.metrics is not None:
+            self.metrics.inc("serve.engine.snapshots")
+        return path
+
+    def restore(self, path: Optional[str] = None) -> dict:
+        """Resume from the last snapshot WITHOUT recompiling.
+
+        Call on a *warmed* engine (fresh-process flow: construct → warmup()
+        → restore()); warmup provides the resident template structure and —
+        via the shared rollout cache — costs no compile when an equal model
+        was already compiled this process.  Overwrites device state, flight
+        tail, and all host bookkeeping with the snapshot's, and reinstates
+        the ingest ring's buffer + ledger so accepted-but-undelivered
+        messages replay through the normal chunk path (the content-hash
+        dedup makes the replay exactly-once).  Returns a summary dict."""
+        path = path if path is not None else self.snapshot_path
+        if path is None:
+            raise ValueError("restore needs a path (ctor or argument)")
+        if self.chunks_run < 1 or not self.flight_tail:
+            raise RuntimeError(
+                "restore() needs a warmed engine (run warmup() first; the "
+                "warmed flight tail is the restore template)"
+            )
+        meta = ckpt.meta(path)
+        if meta.get("kind") != "streaming-engine":
+            raise ValueError(
+                f"{path} is not a streaming-engine checkpoint "
+                f"(kind={meta.get('kind')!r})"
+            )
+        if meta["model"] != self._model_key():
+            raise ValueError(
+                "checkpoint/model config mismatch: "
+                f"snapshot={meta['model']!r} engine={self._model_key()!r}"
+            )
+        if (
+            int(meta["chunk_steps"]) != self.chunk_steps
+            or int(meta["pub_width"]) != self.pub_width
+        ):
+            raise ValueError(
+                "checkpoint chunk shapes "
+                f"({meta['chunk_steps']}x{meta['pub_width']}) != engine "
+                f"({self.chunk_steps}x{self.pub_width}); restoring would "
+                "break the one-compiled-variant contract"
+            )
+        tree = ckpt.restore(
+            path, {"state": self.state, "flight_tail": dict(self.flight_tail)}
+        )
+        self.state = tree["state"]
+        self.flight_tail = {
+            k: np.asarray(jax.device_get(v))
+            for k, v in tree["flight_tail"].items()
+        }
+        self.completion_frac = float(meta["completion_frac"])
+        self.chunks_run = int(meta["chunks_run"])
+        self.published = int(meta["published"])
+        self.completed = int(meta["completed"])
+        self.evicted = int(meta["evicted"])
+        self.replay_deduped = int(meta["replay_deduped"])
+        self.duplicate_completions = int(meta["duplicate_completions"])
+        self.clock_anomalies = int(meta.get("clock_anomalies", 0))
+        self._next_slot = [int(x) for x in meta["next_slot"]]
+        self.publish_log = [
+            PendingMessage(**d) for d in meta["publish_log"]
+        ]
+        by_key = {(p.topic, p.slot): p for p in self.publish_log}
+        self.pending = {
+            (int(t), int(s)): by_key[(int(t), int(s))]
+            for t, s in meta["pending_keys"]
+        }
+        self.invalid_published = [
+            (int(t), int(s)) for t, s in meta["invalid_published"]
+        ]
+        self.latencies_s = [float(x) for x in meta["latencies_s"]]
+        self._seen_hashes = set(meta["seen_hashes"])
+        self._completed_hashes = set(meta["completed_hashes"])
+        replayed = self.ring.restore_snapshot(meta["ring"])
+        self.restores += 1
+        if self.metrics is not None:
+            self.metrics.inc("serve.engine.restores")
+        return {
+            "chunk": self.chunks_run,
+            "replayed": replayed,
+            "pending": len(self.pending),
+            "completed": self.completed,
+        }
 
     # -- views --------------------------------------------------------------
 
@@ -197,6 +426,12 @@ class StreamingEngine:
         if self.metrics is not None:
             self.metrics.gauge("serve.engine.pending", len(self.pending))
             self.metrics.inc("serve.engine.chunks")
+        if (
+            self.snapshot_every > 0
+            and not getattr(self, "_in_warmup", False)
+            and self.chunks_run % self.snapshot_every == 0
+        ):
+            self.snapshot()
         return {
             "chunk": self.chunks_run - 1,
             "items": n_items,
@@ -212,8 +447,23 @@ class StreamingEngine:
         for (topic, slot), p in list(self.pending.items()):
             target = max(1, int(self.completion_frac * participants[topic]))
             if int(delivered[topic, slot]) >= target:
-                self.latencies_s.append(t_done - p.t_ingest)
+                lat = t_done - p.t_ingest
+                if lat < 0.0:
+                    # Host clock skew can make delivery appear to precede
+                    # ingest; clamp and count — never report a negative
+                    # latency silently.
+                    self.clock_anomalies += 1
+                    if self.metrics is not None:
+                        self.metrics.inc("serve.engine.clock_anomalies")
+                    lat = 0.0
+                self.latencies_s.append(lat)
                 self.completed += 1
+                if p.chash:
+                    if p.chash in self._completed_hashes:
+                        self.duplicate_completions += 1
+                        if self.metrics is not None:
+                            self.metrics.inc("serve.engine.duplicates")
+                    self._completed_hashes.add(p.chash)
                 del self.pending[(topic, slot)]
                 done += 1
         if done and self.metrics is not None:

@@ -18,6 +18,18 @@ Backpressure is an explicit, named policy — never an implicit drop:
                     (freshest-wins streams), counting every eviction;
 - ``reject``      — a full ring refuses the newcomer (caller retries).
 
+On top of the policy, the watchdog's first degradation tier can install a
+*shed set* (``set_shed_topics``): pushes for shed topics are refused at the
+door and counted under ``shed_priority`` — like ``reject``, the caller
+still owns the message, so the shed never enters the conservation formula
+as anything but an attributed refusal.
+
+``snapshot()`` / ``restore_snapshot()`` round-trip the buffer contents AND
+the full counter set so a restored ring resumes the same conservation
+ledger.  Restore reinstates counters verbatim — replayed items must NOT
+re-increment ``accepted`` (they were counted at their original admission;
+re-pushing them would double-count and break ``silent_drops == 0``).
+
 ``accounting()`` exposes the conservation check the streaming SLO grades:
 every accepted message is either still queued, handed to the device, or
 attributed to a named policy counter — ``silent_drops`` is the residual and
@@ -29,10 +41,11 @@ Queue-depth and policy counters land on an (optional) existing
 
 from __future__ import annotations
 
+import base64
 import threading
 import time
 from dataclasses import dataclass
-from typing import List, Optional
+from typing import FrozenSet, Iterable, List, Optional
 
 BACKPRESSURE_POLICIES = ("block", "drop_oldest", "reject")
 
@@ -87,8 +100,11 @@ class IngestRing:
         self._accepted = 0
         self._popped = 0
         self._dropped_oldest = 0
+        self._dropped_oldest_valid = 0
         self._rejected = 0
         self._block_waits = 0
+        self._shed_topics: FrozenSet[int] = frozenset()
+        self._shed_priority = 0
 
     # -- producer side ------------------------------------------------------
 
@@ -107,6 +123,10 @@ class IngestRing:
         the ring never took it, so nothing was dropped silently.
         """
         with self._lock:
+            if int(topic) in self._shed_topics:
+                self._shed_priority += 1
+                self._metric_inc("serve.ingest.shed_priority")
+                return False
             if self._size >= self.capacity:
                 if self.policy == "reject":
                     self._rejected += 1
@@ -160,6 +180,111 @@ class IngestRing:
                 self._metric_depth()
         return out
 
+    # -- degradation controls (driven by the serve watchdog) ----------------
+
+    def set_shed_topics(self, topics: Iterable[int]) -> None:
+        """Install the shed set: pushes for these topics are refused at the
+        door and counted under ``shed_priority``.  Pass an empty iterable to
+        clear.  The refusal is loud (counter + metric) and caller-owned —
+        it never appears in the silent-drop residual."""
+        with self._lock:
+            self._shed_topics = frozenset(int(t) for t in topics)
+            self._metric_gauge(
+                "serve.ingest.shed_topics", len(self._shed_topics)
+            )
+
+    def set_policy(self, policy: str) -> None:
+        """Swap the backpressure policy at runtime (watchdog tier 2 moves
+        block→drop_oldest under sustained overload, and back)."""
+        if policy not in BACKPRESSURE_POLICIES:
+            raise ValueError(
+                f"unknown backpressure policy {policy!r}; "
+                f"have: {', '.join(BACKPRESSURE_POLICIES)}"
+            )
+        with self._lock:
+            self.policy = policy
+            # Leaving `block` must release anyone parked on the condition so
+            # they re-evaluate under the new policy.
+            self._not_full.notify_all()
+
+    # -- checkpoint ----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-safe snapshot of buffer contents + the full ledger, taken
+        under the lock (payloads base64-encoded)."""
+        with self._lock:
+            items = []
+            for i in range(self._size):
+                item = self._buf[(self._head + i) % self.capacity]
+                assert item is not None
+                items.append({
+                    "seq": item.seq,
+                    "topic": item.topic,
+                    "publisher": item.publisher,
+                    "payload": base64.b64encode(item.payload).decode("ascii"),
+                    "valid": item.valid,
+                    "t_ingest": item.t_ingest,
+                })
+            return {
+                "capacity": self.capacity,
+                "policy": self.policy,
+                "items": items,
+                "counters": {
+                    "seq": self._seq,
+                    "accepted": self._accepted,
+                    "popped": self._popped,
+                    "dropped_oldest": self._dropped_oldest,
+                    "dropped_oldest_valid": self._dropped_oldest_valid,
+                    "rejected": self._rejected,
+                    "block_waits": self._block_waits,
+                    "shed_priority": self._shed_priority,
+                    "max_depth": self.max_depth,
+                },
+            }
+
+    def restore_snapshot(self, snap: dict) -> int:
+        """Reinstate buffer contents and counters from :meth:`snapshot`.
+
+        Counters are restored VERBATIM — replayed items were already counted
+        as accepted at their original admission, so restoring must not go
+        through ``push`` (that would double-count ``accepted`` and turn the
+        conservation residual negative).  Returns the number of queued
+        items reinstated for replay."""
+        items = snap["items"]
+        if len(items) > self.capacity:
+            raise ValueError(
+                f"snapshot holds {len(items)} items but ring capacity is "
+                f"{self.capacity}"
+            )
+        counters = snap["counters"]
+        with self._lock:
+            self._buf = [None] * self.capacity
+            for i, d in enumerate(items):
+                self._buf[i] = IngestItem(
+                    seq=int(d["seq"]),
+                    topic=int(d["topic"]),
+                    publisher=int(d["publisher"]),
+                    payload=base64.b64decode(d["payload"]),
+                    valid=bool(d["valid"]),
+                    t_ingest=float(d["t_ingest"]),
+                )
+            self._head = 0
+            self._size = len(items)
+            self._seq = int(counters["seq"])
+            self._accepted = int(counters["accepted"])
+            self._popped = int(counters["popped"])
+            self._dropped_oldest = int(counters["dropped_oldest"])
+            self._dropped_oldest_valid = int(
+                counters.get("dropped_oldest_valid", 0)
+            )
+            self._rejected = int(counters["rejected"])
+            self._block_waits = int(counters["block_waits"])
+            self._shed_priority = int(counters.get("shed_priority", 0))
+            self.max_depth = int(counters["max_depth"])
+            self._not_full.notify_all()
+            self._metric_depth()
+            return self._size
+
     # -- introspection ------------------------------------------------------
 
     @property
@@ -176,13 +301,20 @@ class IngestRing:
                 self._accepted - self._popped - self._dropped_oldest
                 - self._size
             )
+            valid_in_queue = sum(
+                1 for i in range(self._size)
+                if self._buf[(self._head + i) % self.capacity].valid
+            )
             return {
                 "accepted": self._accepted,
                 "popped": self._popped,
                 "in_queue": self._size,
+                "valid_in_queue": valid_in_queue,
                 "dropped_oldest": self._dropped_oldest,
+                "dropped_oldest_valid": self._dropped_oldest_valid,
                 "rejected": self._rejected,
                 "block_waits": self._block_waits,
+                "shed_priority": self._shed_priority,
                 "max_depth": self.max_depth,
                 "silent_drops": silent,
             }
@@ -190,15 +322,22 @@ class IngestRing:
     # -- internals ----------------------------------------------------------
 
     def _evict_oldest_locked(self) -> None:
+        victim = self._buf[self._head]
         self._buf[self._head] = None
         self._head = (self._head + 1) % self.capacity
         self._size -= 1
         self._dropped_oldest += 1
+        if victim is not None and victim.valid:
+            self._dropped_oldest_valid += 1
         self._metric_inc("serve.ingest.dropped_oldest")
 
     def _metric_inc(self, name: str) -> None:
         if self.metrics is not None:
             self.metrics.inc(name)
+
+    def _metric_gauge(self, name: str, value) -> None:
+        if self.metrics is not None:
+            self.metrics.gauge(name, value)
 
     def _metric_depth(self) -> None:
         if self.metrics is not None:

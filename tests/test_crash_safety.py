@@ -1,0 +1,461 @@
+"""Crash-safe serving plane (r14).
+
+The contracts under test, in order of importance:
+
+1. `StreamingEngine.snapshot()/restore()` is exactly-once across a crash:
+   a fresh engine resumes from the last chunk boundary, replays
+   accepted-but-undelivered ring messages, dedups resubmissions by
+   content hash, and NEVER recompiles (the shared resident rollout).
+2. The ingest ring's conservation ledger survives checkpoint/restore
+   verbatim — restoring must not double-count `accepted`.
+3. A crash mid-save leaves the previous snapshot byte-usable (the
+   `utils.checkpoint` atomicity contract, exercised through the engine).
+4. The watchdog is deterministic under a fake clock: stall restarts,
+   verifier restarts, and the shed_priority -> drop_oldest ladder with
+   every shed loudly attributed.
+5. The streaming scenario runner stages faults (engine crash, verifier
+   crash, producer stall, clock skew) and the new SLO channels grade real
+   measurements, never vacuous passes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu import scenario
+from go_libp2p_pubsub_tpu.models.multitopic import MultiTopicGossipSub
+from go_libp2p_pubsub_tpu.serve import (
+    IngestRing,
+    StreamingEngine,
+    Watchdog,
+    content_hash,
+)
+from go_libp2p_pubsub_tpu.utils import checkpoint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Own model config (distinct from test_serve's _TINY so the shared rollout
+# cache entry is this module's).  Every engine over this model uses chunk 6
+# x width 2 — engines sharing a compiled rollout must agree on shapes.
+_CRASH_TINY = dict(n_topics=2, n_peers=16, n_slots=8, conn_degree=4,
+                   msg_window=32, heartbeat_steps=4)
+_CHUNK = dict(chunk_steps=6, pub_width=2)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return MultiTopicGossipSub(**_CRASH_TINY)
+
+
+def _pair(model, **kw):
+    ring = IngestRing(capacity=kw.pop("capacity", 16),
+                      policy=kw.pop("policy", "block"))
+    return StreamingEngine(model, ring, **_CHUNK, **kw), ring
+
+
+# ---------------------------------------------------------------------------
+# engine checkpoint/restore
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_restore_exactly_once_no_recompile(model, tmp_path):
+    """The tentpole contract end to end: snapshot mid-flight (pending
+    deliveries + undelivered ring items), crash, restore into a fresh
+    engine, drain — every message delivered exactly once, resubmissions
+    deduped by content hash, and the compile cache never grew."""
+    path = str(tmp_path / "engine.ckpt")
+    eng1, ring1 = _pair(model)
+    eng1.warmup()
+    for i in range(4):
+        ring1.push(topic=i % 2, payload=b"first %d" % i, publisher=i)
+    eng1.run_chunk()
+    # Accepted but not yet popped: these exist ONLY in the ring snapshot.
+    for i in range(4):
+        ring1.push(topic=i % 2, payload=b"second %d" % i, publisher=4 + i)
+    eng1.snapshot(path)
+    assert eng1.compile_cache_size() == 1
+
+    # Crash: eng1 is gone.  The replacement warms up (no compile — the
+    # rollout is shared per model value) then restores.
+    eng2, ring2 = _pair(model)
+    eng2.warmup()
+    info = eng2.restore(path)
+    assert info["replayed"] == 4          # the un-popped ring items
+    assert info["chunk"] == eng1.chunks_run
+    eng2.run_until_drained(max_chunks=16)
+    assert eng2.completed == 8, "lost messages across crash/restore"
+    assert eng2.duplicate_completions == 0
+    assert eng2.compile_cache_size() == 1, "restore recompiled"
+
+    # An at-least-once producer resubmits two already-delivered messages:
+    # same (topic, publisher, payload) -> same content hash -> skipped.
+    ring2.push(topic=0, payload=b"first 0", publisher=0)
+    ring2.push(topic=1, payload=b"first 1", publisher=1)
+    eng2.run_until_drained(max_chunks=16)
+    assert eng2.replay_deduped == 2
+    assert eng2.completed == 8, "resubmission delivered twice"
+
+
+def test_ring_ledger_conserved_across_restore(model, tmp_path):
+    """Satellite: the conservation ledger is reinstated verbatim — the
+    restore path must not run items back through push() (that would
+    double-count `accepted` and break silent_drops = accepted - popped -
+    dropped - size)."""
+    path = str(tmp_path / "engine.ckpt")
+    eng1, ring1 = _pair(model)
+    eng1.warmup()
+    for i in range(6):
+        ring1.push(topic=i % 2, payload=b"led %d" % i, publisher=i)
+    eng1.run_chunk()
+    for i in range(3):
+        ring1.push(topic=0, payload=b"tail %d" % i, publisher=10 + i)
+    eng1.snapshot(path)
+    before = ring1.accounting()
+    assert before["silent_drops"] == 0
+
+    eng2, ring2 = _pair(model)
+    eng2.warmup()
+    eng2.restore(path)
+    after = ring2.accounting()
+    for key in ("accepted", "popped", "in_queue", "dropped_oldest",
+                "silent_drops"):
+        assert after[key] == before[key], \
+            f"{key} changed across restore: {before[key]} -> {after[key]}"
+    eng2.run_until_drained(max_chunks=16)
+    final = ring2.accounting()
+    assert final["silent_drops"] == 0
+    assert final["accepted"] == final["popped"]  # everything drained
+
+
+def test_crash_mid_save_preserves_previous_snapshot(model, tmp_path,
+                                                    monkeypatch):
+    """Satellite: a crash DURING snapshot() leaves the previous checkpoint
+    byte-usable and leaks no temp files (mirrors the utils.checkpoint
+    atomicity test, through the engine's save path)."""
+    path = str(tmp_path / "engine.ckpt")
+    eng, ring = _pair(model)
+    eng.warmup()
+    ring.push(topic=0, payload=b"a", publisher=1)
+    eng.run_chunk()
+    eng.snapshot(path)
+    good_chunk = checkpoint.meta(path)["chunks_run"]
+
+    ring.push(topic=1, payload=b"b", publisher=2)
+    eng.run_chunk()
+    real_savez = checkpoint.np.savez
+
+    def exploding_savez(f, **arrays):
+        real_savez(f, **arrays)
+        raise OSError("disk gone mid-save")
+
+    monkeypatch.setattr(checkpoint.np, "savez", exploding_savez)
+    with pytest.raises(OSError, match="mid-save"):
+        eng.snapshot(path)
+    monkeypatch.undo()
+
+    assert checkpoint.meta(path)["chunks_run"] == good_chunk
+    assert [f for f in os.listdir(tmp_path) if f.endswith(".tmp")] == []
+    # ...and the survivor actually restores.
+    eng2, _ = _pair(model)
+    eng2.warmup()
+    assert eng2.restore(path)["chunk"] == good_chunk
+
+
+def test_restore_rejects_mismatched_config(tmp_path):
+    """Config drift fails loudly: a snapshot from one model/chunk shape
+    must not load into an engine whose compiled program disagrees."""
+    path = str(tmp_path / "engine.ckpt")
+    small = MultiTopicGossipSub(**dict(_CRASH_TINY, msg_window=16))
+    eng, ring = _pair(small)
+    eng.warmup()
+    ring.push(topic=0, payload=b"x", publisher=1)
+    eng.run_chunk()
+    eng.snapshot(path)
+
+    other = MultiTopicGossipSub(**dict(_CRASH_TINY, msg_window=8))
+    eng2, _ = _pair(other)
+    eng2.warmup()
+    with pytest.raises(ValueError, match="mismatch"):
+        eng2.restore(path)
+
+    eng3 = StreamingEngine(small, IngestRing(capacity=16),
+                           chunk_steps=4, pub_width=2)
+    eng3.warmup()
+    with pytest.raises(ValueError, match="chunk shapes"):
+        eng3.restore(path)
+
+    not_engine = str(tmp_path / "other.ckpt")
+    checkpoint.save(not_engine, {"x": np.zeros(3)}, meta={"kind": "other"})
+    with pytest.raises(ValueError, match="streaming-engine"):
+        eng.restore(not_engine)
+
+
+def test_content_hash_identity():
+    """The exactly-once identity: stable in (topic, publisher, payload),
+    distinct when any coordinate differs."""
+    a = content_hash(0, 1, b"payload")
+    assert a == content_hash(0, 1, b"payload")
+    assert a != content_hash(1, 1, b"payload")
+    assert a != content_hash(0, 2, b"payload")
+    assert a != content_hash(0, 1, b"payloae")
+
+
+# ---------------------------------------------------------------------------
+# watchdog (fake clock, deterministic)
+# ---------------------------------------------------------------------------
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_watchdog_restarts_stalled_engine(model, tmp_path):
+    path = str(tmp_path / "engine.ckpt")
+    eng, ring = _pair(model)
+    eng.warmup()
+    ring.push(topic=0, payload=b"w", publisher=1)
+    eng.run_chunk()
+    eng.snapshot(path)
+
+    clock = _FakeClock()
+    restarted = []
+    wd = Watchdog(eng, ring, checkpoint_path=path, chunk_stall_s=5.0,
+                  on_engine_restart=restarted.append, clock=clock)
+    wd.note_chunk()
+    clock.t = 4.0
+    assert wd.poll() == []                # under threshold: no action
+    clock.t = 10.0
+    assert wd.poll() == ["engine_restart"]
+    assert wd.engine_restarts == 1 and eng.restores == 1
+    assert restarted[0]["chunk"] >= 1
+    clock.t = 12.0
+    assert wd.poll() == []                # stamp was reset by the restart
+
+
+def test_watchdog_restarts_dead_verifier():
+    clock = _FakeClock()
+    rebuilt = []
+    stub = SimpleNamespace(model=SimpleNamespace(t=2))
+    wd = Watchdog(stub, IngestRing(capacity=8), chunk_stall_s=100.0,
+                  verifier_stall_s=3.0,
+                  on_verifier_restart=lambda: rebuilt.append(clock.t),
+                  clock=clock)
+    wd.note_verifier()
+    clock.t = 2.0
+    assert wd.poll() == []
+    clock.t = 5.0
+    assert wd.poll() == ["verifier_restart"]
+    assert wd.verifier_restarts == 1 and rebuilt == [5.0]
+
+
+def test_watchdog_tier_ladder_sheds_loudly():
+    """Overload walks normal -> shed_priority -> drop_oldest one tier per
+    poll, every refusal attributed in the ledger (silent_drops stays 0),
+    and the original policy returns on the way back down."""
+    clock = _FakeClock()
+    ring = IngestRing(capacity=8, policy="reject")
+    stub = SimpleNamespace(model=SimpleNamespace(t=2))
+    wd = Watchdog(stub, ring, chunk_stall_s=100.0,
+                  high_watermark=6, low_watermark=2,
+                  topic_priority=[0, 1], clock=clock)
+    assert wd.tier_name == "normal"
+
+    for i in range(6):
+        assert ring.push(topic=1, payload=b"t%d" % i, publisher=i)
+    assert wd.poll() == ["tier_up"] and wd.tier_name == "shed_priority"
+    # Tier 1: topic 0 (priority 0 < 1) is refused at the door, attributed.
+    assert not ring.push(topic=0, payload=b"shed me", publisher=9)
+    assert ring.accounting()["shed_priority"] == 1
+    assert ring.push(topic=1, payload=b"keep", publisher=9)  # priority topic
+
+    assert wd.poll() == ["tier_up"] and wd.tier_name == "drop_oldest"
+    assert ring.policy == "drop_oldest"
+    # Tier 2: pushing past capacity evicts the oldest — counted, not silent.
+    assert ring.push(topic=1, payload=b"fresh0", publisher=10)
+    assert ring.push(topic=1, payload=b"fresh1", publisher=10)
+    acct = ring.accounting()
+    assert acct["dropped_oldest"] == 1 and acct["silent_drops"] == 0
+
+    ring.pop_batch(8)                      # drain below the low watermark
+    assert wd.poll() == ["tier_down"] and wd.tier_name == "shed_priority"
+    assert wd.poll() == ["tier_down"] and wd.tier_name == "normal"
+    assert ring.policy == "reject"         # original policy restored
+    assert ring.push(topic=0, payload=b"welcome back", publisher=1)
+    assert len(wd.tier_log) == 4
+    assert ring.accounting()["silent_drops"] == 0
+
+
+def test_watchdog_rejects_bad_config(model):
+    eng, ring = _pair(model)
+    with pytest.raises(ValueError, match="chunk_stall_s"):
+        Watchdog(eng, ring, chunk_stall_s=0.0)
+    with pytest.raises(ValueError, match="watermark"):
+        Watchdog(eng, ring, high_watermark=2, low_watermark=4)
+    with pytest.raises(ValueError, match="topic_priority"):
+        Watchdog(eng, ring, topic_priority=[1, 2, 3])
+
+
+# ---------------------------------------------------------------------------
+# streaming chaos: faults through the scenario runner
+# ---------------------------------------------------------------------------
+
+
+def _fault_spec(**kw):
+    streaming = {
+        "streaming_only": True, "chunk_steps": 6, "capacity": 8,
+        "policy": "block",
+    }
+    streaming.update(kw.pop("streaming", {}))
+    slo = kw.pop("slo", scenario.SLO(
+        min_delivery_frac=0.9, max_queue_depth=8, max_silent_drops=0,
+        max_recovery_s=60.0, max_lost_after_restart=0,
+        max_duplicate_deliveries=0,
+    ))
+    return scenario.ScenarioSpec(
+        name="tiny_fault_stream",
+        family="multitopic",
+        n_steps=12,
+        seed=7,
+        model=kw.pop("model", dict(_CRASH_TINY)),
+        workloads=[scenario.Workload(kind="constant", topic=0, start=0,
+                                     stop=12, every=2)],
+        streaming=streaming,
+        slo=slo,
+        **kw,
+    )
+
+
+def test_runner_engine_crash_recovers_exactly_once():
+    spec = _fault_spec(streaming={"snapshot_every": 1, "crash_at_chunk": 1})
+    res = scenario.run_streaming_scenario(spec)
+    assert res.verdict.passed, str(res.verdict)
+    assert res.engine_stats["restores"] == 1
+    assert res.engine_stats["watchdog_restarts"] == 1
+    assert res.engine_stats["compile_cache_size"] == 1
+    assert res.record["lost_after_restart"][-1] == 0
+    assert res.record["duplicate_deliveries"][-1] == 0
+    assert res.record["recovery_s"][-1] > 0
+
+
+def test_runner_verifier_crash_resubmits_and_dedups():
+    spec = _fault_spec(streaming={"verifier_crash_at_chunk": 2})
+    res = scenario.run_streaming_scenario(spec)
+    assert res.verdict.passed, str(res.verdict)
+    assert res.engine_stats["pipeline_restarts"] == 1
+    # The retry window resubmitted the already-published group; content-hash
+    # dedup turned at-least-once into exactly-once.
+    assert res.engine_stats["replay_deduped"] > 0
+    assert res.record["duplicate_deliveries"][-1] == 0
+
+
+def test_runner_producer_stall_defers_publishes():
+    spec = _fault_spec(streaming={"producer_stall": {"start": 2, "steps": 4}})
+    res = scenario.run_streaming_scenario(spec)
+    assert res.verdict.passed, str(res.verdict)
+    # Unfaulted crash channels are REAL zeros, not absent.
+    assert res.record["recovery_s"][-1] == 0
+    assert res.record["lost_after_restart"][-1] == 0
+
+
+def test_runner_clock_skew_clamps_and_counts():
+    # Short chunks (and a model config of its own, so the shared rollout
+    # for _CRASH_TINY keeps exactly one compiled shape) put deliveries in
+    # flight ACROSS the skew boundary — the only way a negative
+    # ingest→delivery interval can actually occur.
+    spec = _fault_spec(
+        model=dict(_CRASH_TINY, msg_window=24),
+        streaming={"chunk_steps": 2,
+                   "clock_skew": {"at_chunk": 1, "skew_s": -5.0}})
+    res = scenario.run_streaming_scenario(spec)
+    assert res.verdict.passed, str(res.verdict)
+    assert res.engine_stats["clock_anomalies"] > 0
+    assert res.record["ingest_lat_p50_s"][-1] >= 0  # clamped, never negative
+
+
+def test_fault_lowering_validates():
+    with pytest.raises(ValueError, match="crash_at_chunk"):
+        scenario.compile_streaming_plan(
+            _fault_spec(streaming={"crash_at_chunk": 99}))
+    with pytest.raises(ValueError, match="snapshot_every"):
+        scenario.compile_streaming_plan(
+            _fault_spec(streaming={"crash_at_chunk": 1,
+                                   "snapshot_every": 0}))
+    with pytest.raises(ValueError, match="producer_stall"):
+        scenario.compile_streaming_plan(
+            _fault_spec(streaming={"producer_stall": {"start": 10,
+                                                      "steps": 8}}))
+
+
+def test_slo_crash_channels_fail_loudly_when_missing():
+    spec = _fault_spec()
+    with pytest.raises(ValueError, match="recovery_s"):
+        scenario.evaluate(spec, {
+            "delivery_frac": np.ones(1), "queue_depth_peak": np.zeros(1),
+            "ingest_lat_max_s": np.zeros(1), "silent_drops": np.zeros(1),
+            "duplicate_deliveries": np.zeros(1, np.int64),
+        }, 1)
+
+
+# ---------------------------------------------------------------------------
+# fuzzer: streaming plane + defense search sampling
+# ---------------------------------------------------------------------------
+
+
+def test_fuzz_streaming_sampler_deterministic():
+    import importlib
+
+    fuzz = importlib.import_module("tools.scenario_fuzz")
+    specs = [fuzz.sample_streaming_spec(0, i) for i in range(6)]
+    again = [fuzz.sample_streaming_spec(0, i) for i in range(6)]
+    assert [s.to_json() for s in specs] == [s.to_json() for s in again]
+    assert len({fuzz._digest(s) for s in specs}) == 6
+    # Streaming samples are attack-free serving configs with crash SLOs.
+    for s in specs:
+        assert not s.attacks and s.streaming
+        assert s.slo.max_lost_after_restart == 0
+    # Any crash sample stages a snapshot cadence (else it can't restore).
+    for s in specs:
+        if "crash_at_chunk" in s.streaming:
+            assert s.streaming.get("snapshot_every", 0) >= 1
+
+
+def test_fuzz_defense_sampler_deterministic():
+    import importlib
+
+    fuzz = importlib.import_module("tools.scenario_fuzz")
+    a = [fuzz.sample_defense(3, i) for i in range(8)]
+    b = [fuzz.sample_defense(3, i) for i in range(8)]
+    assert a == b
+    assert len({fuzz._digest_obj(d) for d in a}) == 8
+    for d in a:  # the mandatory axis is always present and punitive
+        assert d["invalid_message_deliveries_weight"] < 0
+
+
+@pytest.mark.slow
+def test_fuzz_cli_streaming_plane_end_to_end():
+    """`scenario_fuzz --plane streaming` runs a real seeded hunt: every
+    sample grades through the streaming runner and the trajectory labels
+    faults by name."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "scenario_fuzz.py"),
+         "--plane", "streaming", "--budget", "2", "--seed", "0", "--json"],
+        capture_output=True, text=True, timeout=540,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout)
+    assert out["summary"]["plane"] == "streaming"
+    assert len(out["trajectory"]) == 2
+    for e in out["trajectory"]:
+        assert e["status"] in ("red", "green", "invalid")
+        assert e["kind"] in ("engine_crash", "verifier_crash",
+                             "producer_stall", "clock_skew", "no_fault")

@@ -414,13 +414,17 @@ def test_live_only_canon_flagged_and_filtered():
         assert s.live_only
         assert not scenario.sim_supported(s)
         assert scenario.live_supported(s)
-    for name in ("streaming_steady", "streaming_burst_overload"):
+    for name in ("streaming_steady", "streaming_burst_overload",
+                 "streaming_engine_crash_recovery",
+                 "streaming_verifier_crash"):
         s = scenario.build(name)
         assert s.streaming_only
         assert not scenario.sim_supported(s)
         assert scenario.streaming_supported(s)
     single_plane = ("root_kill_failover", "live_partition_heal",
-                    "streaming_steady", "streaming_burst_overload")
+                    "streaming_steady", "streaming_burst_overload",
+                    "streaming_engine_crash_recovery",
+                    "streaming_verifier_crash")
     assert all(scenario.sim_supported(s)
                for s in scenario.build_all()
                if s.name not in single_plane)

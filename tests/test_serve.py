@@ -332,7 +332,9 @@ def test_slo_streaming_criteria_fail_loudly_without_channels():
 
 @pytest.mark.slow
 def test_streaming_canon_green():
-    for name in ("streaming_steady", "streaming_burst_overload"):
+    for name in ("streaming_steady", "streaming_burst_overload",
+                 "streaming_engine_crash_recovery",
+                 "streaming_verifier_crash"):
         res = scenario.run_streaming_scenario(scenario.build(name))
         assert res.verdict.passed, str(res.verdict)
         assert res.engine_stats["compile_cache_size"] == 1
@@ -358,6 +360,9 @@ def test_scenario_run_list_labels_streaming_plane():
     lines = {l.split()[0]: l for l in r.stdout.splitlines() if l.strip()}
     assert "streaming" in lines["streaming_steady"]
     assert "streaming" in lines["streaming_burst_overload"]
+    # r14 fault canon rides the same plane label
+    assert "streaming" in lines["streaming_engine_crash_recovery"]
+    assert "streaming" in lines["streaming_verifier_crash"]
     assert "sim" in lines["steady_state"]
 
 

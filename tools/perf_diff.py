@@ -102,6 +102,24 @@ SCALAR_ROWS: List[Tuple[Tuple[str, ...], str, bool]] = [
     (("streaming", "hot", "ingest_p99_s"),
      "streaming hot ingest p99 (s)", False),
     (("streaming", "warmup_s"), "streaming warmup (s)", False),
+    # Crash-safety subsections (r14+); same warn-not-crash behavior when an
+    # older record predates the faulted/degraded sections.
+    (("streaming", "faulted", "recovery_p50_s"),
+     "streaming recovery p50 (s)", False),
+    (("streaming", "faulted", "recovery_p99_s"),
+     "streaming recovery p99 (s)", False),
+    (("streaming", "faulted", "lost_after_restart"),
+     "streaming lost after restart", False),
+    (("streaming", "faulted", "duplicate_completions"),
+     "streaming duplicate deliveries", False),
+    (("streaming", "faulted", "snapshot_overhead_s"),
+     "streaming snapshot overhead (s)", False),
+    (("streaming", "degraded", "degraded_msgs_per_sec"),
+     "streaming degraded msgs/sec", True),
+    (("streaming", "degraded", "shed_priority"),
+     "streaming shed (priority)", False),
+    (("streaming", "degraded", "dropped_oldest"),
+     "streaming dropped (oldest)", False),
     # Scenario-canon inventory section (r13+); same warn-not-crash behavior
     # as sharded/rlnc/streaming when a record lacks it.
     (("scenario_canon", "count"), "canon scenario count", True),
@@ -292,6 +310,16 @@ def context_warnings(old: Dict[str, Any], new: Dict[str, Any]) -> List[str]:
                 warns.append(
                     f"streaming {key} differs: {to.get(key)!r} vs "
                     f"{tn.get(key)!r}"
+                )
+        # Crash-safety subsections (r14+): their absence in an older record
+        # makes the recovery/degraded rows one-sided, not a crash.
+        for sub in ("faulted", "degraded"):
+            if (sub in to) != (sub in tn):
+                which = "old" if sub not in to else "new"
+                warns.append(
+                    f"only one record has a streaming '{sub}' subsection "
+                    f"(missing in {which}; added in r14) — its rows are "
+                    f"one-sided"
                 )
     # Scenario-canon inventory section (r13+): same treatment, plus a
     # loud word when an attack kind covered by the old canon vanished.

@@ -10,6 +10,25 @@ pure function of ``--seed``: every draw comes from
 reproducible bit-for-bit and a red config can be re-derived from its
 index alone.
 
+The search space covers all three planes (``--plane``, r14):
+
+- ``sim`` (default): attack campaigns against the scored defense;
+- ``streaming``: serving-plane chaos — backpressure policy x workload
+  shape x fault stage (engine crash, verifier crash, producer stall,
+  clock skew) x snapshot cadence, graded by the conservation +
+  exactly-once SLOs.  A red here is a fragile SERVING config (e.g. a
+  snapshot period too slow for the crash point loses accepted messages);
+- ``live``: socket-plane campaigns (churn, link delay windows) over small
+  host counts.  The sampled trajectory is deterministic; verdicts on this
+  plane inherit the live canon's wall-clock sensitivity.
+
+``--search defense`` (sim plane only) inverts the hunt: instead of
+sampling attacks against a fixed defense, it samples SCORE-PARAMETER
+configurations and grades each against a fixed battery of canon attack
+campaigns — hunting for fragile defense configs, not strong attacks
+(ROADMAP item 4's leftover).  Every defense draw comes from
+``np.random.default_rng([seed, _TAG_DEFENSE, index])``.
+
 A red config can then be SHRUNK (``--shrink``): greedy coordinate descent
 over a fixed mutation schedule (drop churn, drop links, fewer attackers,
 shorter campaign, sparser spam), keeping each mutation only while the
@@ -24,6 +43,8 @@ Usage::
     python tools/scenario_fuzz.py --budget 40 --seed 0 --shrink \
         --save-red red.json
     python tools/scenario_fuzz.py --budget 5 --seed 0 --json   # smoke
+    python tools/scenario_fuzz.py --plane streaming --budget 10 --seed 0
+    python tools/scenario_fuzz.py --search defense --budget 5 --seed 0
 
 Exit code 0 when the hunt completes (red findings are the OUTPUT, not a
 failure); 1 on usage errors.
@@ -69,6 +90,9 @@ from go_libp2p_pubsub_tpu.scenario.spec import (  # noqa: E402
 # (1..4 in scenario/compiler.py), so a fuzzed spec's own lowering draws
 # never alias the search's draws.
 _TAG_FUZZ = 5
+# Defense-search substream: disjoint from _TAG_FUZZ so the same seed can
+# run both hunts without aliased draws.
+_TAG_DEFENSE = 6
 
 # The standing defense: the scored config the canon shipped BEFORE the
 # taxonomy PR — P4 hammer + P6 colocation, P3 at its shipped default
@@ -189,18 +213,258 @@ def sample_spec(seed: int, index: int, defense: dict) -> ScenarioSpec:
     )
 
 
+# One fixed serving mesh for the streaming hunt, for the same reason as
+# _FUZZ_MESH: every sample shares the model value, so the resident chunk
+# compiles once per (chunk_steps, pub_width) across the whole budget.
+_STREAM_FUZZ_MESH = dict(
+    n_topics=2, n_peers=32, n_slots=16, conn_degree=4, msg_window=64,
+    heartbeat_steps=4,
+)
+_STREAM_N_STEPS = 32
+_STREAM_CHUNK_STEPS = 8
+
+
+def streaming_standing_slo(capacity: int, has_crash: bool) -> SLO:
+    """The serving-plane invariant grade: conservation exact, delivery
+    exactly-once, backlog bounded by the ring, and — when a crash is
+    staged — recovery bounded and lossless."""
+    kw = dict(
+        min_delivery_frac=0.90,
+        max_queue_depth=capacity,
+        max_silent_drops=0,
+        max_lost_after_restart=0,
+        max_duplicate_deliveries=0,
+    )
+    if has_crash:
+        kw.update(max_recovery_s=60.0)
+    return SLO(**kw)
+
+
+def sample_streaming_spec(
+    seed: int, index: int, defense: Optional[dict] = None
+) -> ScenarioSpec:
+    """Draw one serving-plane chaos scenario (pure in (seed, index)).
+
+    The fragility axes are policy x load shape x fault stage x snapshot
+    cadence.  ``snapshot_every=2`` with a crash on an odd chunk is a
+    deliberately reachable red: the unsnapshotted chunk's messages are
+    lost, and ``max_lost_after_restart=0`` says so.  Block-policy loads
+    are capacity-matched so a single-threaded hunt never parks in the
+    ring's blocking push."""
+    rng = np.random.default_rng([seed, _TAG_FUZZ, index])
+    policy = str(rng.choice(["block", "drop_oldest", "reject"]))
+    capacity = int(rng.choice([8, 12, 16]))
+
+    workloads = []
+    per_chunk = 0
+    for topic in range(int(rng.integers(1, 3))):
+        every = int(rng.choice([2, 4]))
+        workloads.append(Workload(
+            kind="constant", topic=topic, start=topic,
+            stop=_STREAM_N_STEPS, every=every,
+        ))
+        per_chunk += _STREAM_CHUNK_STEPS // every
+    if policy != "block" and rng.random() < 0.4:
+        workloads.append(Workload(
+            kind="burst", topic=0, start=int(rng.integers(0, 8)),
+            n_msgs=int(rng.integers(8, 25)),
+        ))
+
+    streaming = {
+        "streaming_only": True,
+        "chunk_steps": _STREAM_CHUNK_STEPS,
+        "capacity": capacity,
+        "policy": policy,
+    }
+    fault = str(rng.choice(
+        ["none", "crash", "verifier", "stall", "skew"],
+        p=[0.15, 0.30, 0.20, 0.20, 0.15],
+    ))
+    n_chunks = _STREAM_N_STEPS // _STREAM_CHUNK_STEPS
+    deferred = 0
+    if fault == "crash":
+        streaming["crash_at_chunk"] = int(rng.integers(1, n_chunks))
+        streaming["snapshot_every"] = int(rng.choice([1, 2]))
+    elif fault == "verifier":
+        streaming["verifier_crash_at_chunk"] = int(rng.integers(1, n_chunks))
+    elif fault == "stall":
+        start = int(rng.integers(2, 12))
+        steps = int(rng.integers(4, 13))
+        streaming["producer_stall"] = {"start": start, "steps": steps}
+        deferred = sum(
+            1 for w in workloads if w.kind == "constant"
+            for t in range(start, start + steps)
+            if t >= w.start and (t - w.start) % w.every == 0
+        )
+    elif fault == "skew":
+        streaming["clock_skew"] = {
+            "at_chunk": int(rng.integers(1, n_chunks)),
+            "skew_s": float(rng.choice([-2.0, -0.5, 0.5, 2.0])),
+        }
+    if policy == "block":
+        # No blocking stalls in a single-threaded hunt: one flush's worth
+        # of pushes (a group, doubled by the verifier retry window, plus
+        # any stall-deferred flood) must fit the ring.
+        need = per_chunk * (2 if fault == "verifier" else 1) + deferred
+        if need > capacity:
+            streaming["capacity"] = capacity = need
+
+    return ScenarioSpec(
+        name=f"fuzz_stream_s{seed}_i{index:04d}",
+        family="multitopic",
+        n_steps=_STREAM_N_STEPS,
+        seed=int(rng.integers(0, 2**31 - 1)),
+        model=dict(_STREAM_FUZZ_MESH),
+        workloads=workloads,
+        streaming=streaming,
+        slo=streaming_standing_slo(capacity, fault == "crash"),
+        description=f"fuzzed serving chaos: {fault} fault, {policy} "
+                    f"policy (search seed {seed}, index {index})",
+    )
+
+
+def sample_live_spec(
+    seed: int, index: int, defense: Optional[dict] = None
+) -> ScenarioSpec:
+    """Draw one socket-plane campaign (pure in (seed, index)): small host
+    counts, churn and link-delay windows — the components the live runner
+    lowers.  Verdicts inherit the live plane's wall-clock sensitivity."""
+    rng = np.random.default_rng([seed, _TAG_FUZZ, index])
+    n_hosts = int(rng.choice([4, 5, 6]))
+    n_steps = int(rng.integers(16, 25))
+    workloads = [Workload(
+        kind="constant", start=2, stop=n_steps - 2,
+        every=int(rng.choice([2, 4])),
+    )]
+    churn = []
+    if rng.random() < 0.4:
+        c0 = int(rng.integers(4, 8))
+        churn.append(ChurnPhase(
+            start=c0, stop=min(c0 + int(rng.integers(4, 9)), n_steps - 4),
+            every=4, kills_per_event=1, graceful=True,
+        ))
+    links = []
+    if rng.random() < 0.4:
+        l0 = int(rng.integers(2, 8))
+        links.append(LinkWindow(
+            start=l0, stop=min(l0 + int(rng.integers(4, 10)), n_steps - 2),
+            delay=1, frac=float(rng.uniform(0.2, 0.5)),
+        ))
+    return ScenarioSpec(
+        name=f"fuzz_live_s{seed}_i{index:04d}",
+        family="gossipsub",
+        n_steps=n_steps,
+        seed=int(rng.integers(0, 2**31 - 1)),
+        workloads=workloads,
+        churn=churn,
+        links=links,
+        live={"n_hosts": n_hosts},
+        slo=SLO(min_delivery_frac=0.80),
+        description=f"fuzzed live campaign, {n_hosts} hosts "
+                    f"(search seed {seed}, index {index})",
+    )
+
+
+SAMPLERS = {
+    "sim": sample_spec,
+    "streaming": sample_streaming_spec,
+    "live": sample_live_spec,
+}
+
+
+# ---------------------------------------------------------------------------
+# defense-parameter search (sim plane)
+# ---------------------------------------------------------------------------
+
+# Canon attack campaigns every sampled defense must survive.  Chosen to
+# cover the three standing-failure axes the taxonomy PR measured: score
+# starvation from boot, reputation built then spent, and raw spam volume.
+DEFENSE_BATTERY = ("cold_boot_eclipse", "covert_flash", "spam_flood")
+
+
+def sample_defense(seed: int, index: int) -> dict:
+    """Draw one score-parameter configuration (pure in (seed, index)).
+
+    Log-uniform over the penalty weights (their useful range spans decades)
+    with each optional penalty independently enabled, so the search reaches
+    both over-tuned hammers and defenses with a whole axis missing — the
+    fragile configs this mode hunts."""
+    rng = np.random.default_rng([seed, _TAG_DEFENSE, index])
+    defense = {
+        "invalid_message_deliveries_weight":
+            -float(10.0 ** rng.uniform(0.0, 2.0)),
+    }
+    if rng.random() < 0.8:
+        defense["ip_colocation_factor_weight"] = (
+            -float(10.0 ** rng.uniform(-1.0, 1.0))
+        )
+        defense["ip_colocation_factor_threshold"] = float(rng.integers(1, 5))
+    if rng.random() < 0.5:
+        defense["mesh_message_deliveries_weight"] = (
+            -float(10.0 ** rng.uniform(-1.0, 0.5))
+        )
+        defense["mesh_message_deliveries_threshold"] = (
+            float(rng.uniform(0.5, 4.0))
+        )
+        defense["mesh_message_deliveries_activation_s"] = (
+            float(rng.choice([2.0, 3.0, 5.0, 8.0]))
+        )
+    if rng.random() < 0.5:
+        defense["behaviour_penalty_weight"] = (
+            -float(10.0 ** rng.uniform(-1.0, 1.0))
+        )
+    return defense
+
+
+def grade_defense(defense: dict, battery=DEFENSE_BATTERY):
+    """Grade one defense config against the canon battery.
+
+    Returns (status, [(campaign, status, failed-criteria), ...]): red when
+    ANY battery campaign goes red under this defense — a fragile config
+    finding, the mirror image of the attack hunt."""
+    results = []
+    worst = "green"
+    for name in battery:
+        spec = scenario.CANON[name]()
+        spec = dataclasses.replace(
+            spec,
+            name=f"{spec.name}@defense",
+            model=dict(spec.model, score_params=dict(defense)),
+        )
+        status, _, failed = _grade(spec)
+        results.append((name, status, failed))
+        if status == "red":
+            worst = "red"
+        elif status == "invalid" and worst != "red":
+            worst = "invalid"
+    return worst, results
+
+
 def _digest(spec: ScenarioSpec) -> str:
     return hashlib.sha256(spec.to_json().encode()).hexdigest()[:12]
 
 
-def _grade(spec: ScenarioSpec):
+def _digest_obj(obj) -> str:
+    return hashlib.sha256(
+        json.dumps(obj, sort_keys=True).encode()
+    ).hexdigest()[:12]
+
+
+_RUNNERS = {
+    "sim": lambda spec: scenario.run_scenario(spec),
+    "streaming": lambda spec: scenario.run_streaming_scenario(spec),
+    "live": lambda spec: scenario.run_live_scenario(spec),
+}
+
+
+def _grade(spec: ScenarioSpec, plane: str = "sim"):
     """Run one spec -> (status, verdict | None, failed-criteria names).
 
     "invalid" means the spec failed compile-time validation — a boundary
     of the search space, not a defense failure.
     """
     try:
-        res = scenario.run_scenario(spec)
+        res = _RUNNERS[plane](spec)
     except (ValueError, RuntimeError) as e:
         return "invalid", None, [str(e).splitlines()[0][:80]]
     v = res.verdict
@@ -212,30 +476,55 @@ def _grade(spec: ScenarioSpec):
 # shrinking
 # ---------------------------------------------------------------------------
 
-def _mutations(spec: ScenarioSpec) -> List[ScenarioSpec]:
+def _mutations(spec: ScenarioSpec, plane: str = "sim") -> List[ScenarioSpec]:
     """Candidate simplifications, most aggressive first.  Invalid
     candidates are fine — the shrink loop grades and discards them."""
     out: List[ScenarioSpec] = []
     rep = dataclasses.replace
+    if plane == "streaming":
+        # Serving-plane shrink axis: drop fault stages one at a time, then
+        # thin the workload — the minimal red names the one fault + load
+        # shape that actually breaks the config.
+        cfg = dict(spec.streaming or {})
+        for key in ("clock_skew", "producer_stall",
+                    "verifier_crash_at_chunk", "crash_at_chunk"):
+            if key in cfg:
+                smaller = {
+                    k: v for k, v in cfg.items()
+                    if k != key and not (
+                        key == "crash_at_chunk" and k == "snapshot_every"
+                    )
+                }
+                out.append(rep(spec, streaming=smaller))
+        if len(spec.workloads) > 1:
+            out.append(rep(spec, workloads=spec.workloads[:-1]))
+        for wl in spec.workloads[:1]:
+            if wl.kind == "constant" and wl.every < 8:
+                out.append(rep(spec, workloads=(
+                    [dataclasses.replace(wl, every=wl.every * 2)]
+                    + spec.workloads[1:]
+                )))
+        return out
     if spec.churn:
         out.append(rep(spec, churn=[]))
     if spec.links:
         out.append(rep(spec, links=[]))
-    w = spec.attacks[0]
-    if w.kind != "eclipse" and w.n_attackers > 1:
-        out.append(rep(spec, attacks=[
-            dataclasses.replace(w, n_attackers=w.n_attackers - 1)
-        ]))
     if spec.n_steps > 24:
         out.append(rep(spec, n_steps=spec.n_steps - 8))
-    if w.spam_every and w.spam_every < 8:
-        out.append(rep(spec, attacks=[
-            dataclasses.replace(w, spam_every=w.spam_every * 2)
-        ]))
-    if w.stop is not None and w.stop - w.start > 16:
-        out.append(rep(spec, attacks=[
-            dataclasses.replace(w, stop=w.stop - 8)
-        ]))
+    if spec.attacks:
+        w = spec.attacks[0]
+        if w.kind != "eclipse" and w.n_attackers > 1:
+            out.append(rep(spec, attacks=[
+                dataclasses.replace(w, n_attackers=w.n_attackers - 1)
+            ]))
+        if w.spam_every and w.spam_every < 8:
+            out.append(rep(spec, attacks=[
+                dataclasses.replace(w, spam_every=w.spam_every * 2)
+            ]))
+        if w.stop is not None and w.stop - w.start > 16:
+            out.append(rep(spec, attacks=[
+                dataclasses.replace(w, stop=w.stop - 8)
+            ]))
     for wl in (spec.workloads or []):
         if wl.every < 8:
             out.append(rep(spec, workloads=[
@@ -245,15 +534,19 @@ def _mutations(spec: ScenarioSpec) -> List[ScenarioSpec]:
     return out
 
 
-def shrink(spec: ScenarioSpec, log: Callable[[str], None]) -> ScenarioSpec:
+def shrink(
+    spec: ScenarioSpec,
+    log: Callable[[str], None],
+    plane: str = "sim",
+) -> ScenarioSpec:
     """Greedy coordinate descent: apply any mutation that stays red until
     none does.  Deterministic — the mutation schedule is fixed."""
     current = spec
     improved = True
     while improved:
         improved = False
-        for cand in _mutations(current):
-            status, _, failed = _grade(cand)
+        for cand in _mutations(current, plane):
+            status, _, failed = _grade(cand, plane)
             if status == "red":
                 log(f"  shrink kept: {_describe_delta(current, cand)} "
                     f"(still red on {', '.join(failed)})")
@@ -268,15 +561,22 @@ def _describe_delta(old: ScenarioSpec, new: ScenarioSpec) -> str:
         return "drop churn"
     if old.links and not new.links:
         return "drop links"
+    if (old.streaming or {}) != (new.streaming or {}):
+        gone = set(old.streaming or {}) - set(new.streaming or {})
+        return f"drop fault {'/'.join(sorted(gone))}" if gone \
+            else "streaming config"
     if old.n_steps != new.n_steps:
         return f"n_steps {old.n_steps}->{new.n_steps}"
-    ow, nw = old.attacks[0], new.attacks[0]
-    if ow.n_attackers != nw.n_attackers:
-        return f"n_attackers {ow.n_attackers}->{nw.n_attackers}"
-    if ow.spam_every != nw.spam_every:
-        return f"spam_every {ow.spam_every}->{nw.spam_every}"
-    if ow.stop != nw.stop:
-        return f"attack stop {ow.stop}->{nw.stop}"
+    if len(old.workloads) != len(new.workloads):
+        return f"workloads {len(old.workloads)}->{len(new.workloads)}"
+    if old.attacks and new.attacks:
+        ow, nw = old.attacks[0], new.attacks[0]
+        if ow.n_attackers != nw.n_attackers:
+            return f"n_attackers {ow.n_attackers}->{nw.n_attackers}"
+        if ow.spam_every != nw.spam_every:
+            return f"spam_every {ow.spam_every}->{nw.spam_every}"
+        if ow.stop != nw.stop:
+            return f"attack stop {ow.stop}->{nw.stop}"
     if old.workloads and new.workloads \
             and old.workloads[0].every != new.workloads[0].every:
         return (f"workload every {old.workloads[0].every}->"
@@ -288,6 +588,27 @@ def _describe_delta(old: ScenarioSpec, new: ScenarioSpec) -> str:
 # CLI
 # ---------------------------------------------------------------------------
 
+def _spec_kind(spec: ScenarioSpec, plane: str) -> str:
+    """Short trajectory label: attack kind (sim), staged fault (streaming),
+    or the host count (live)."""
+    if spec.attacks:
+        return spec.attacks[0].kind
+    if plane == "streaming":
+        cfg = spec.streaming or {}
+        for key, label in (
+            ("crash_at_chunk", "engine_crash"),
+            ("verifier_crash_at_chunk", "verifier_crash"),
+            ("producer_stall", "producer_stall"),
+            ("clock_skew", "clock_skew"),
+        ):
+            if key in cfg:
+                return label
+        return "no_fault"
+    if plane == "live":
+        return f"live/{(spec.live or {}).get('n_hosts', '?')}h"
+    return "none"
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         description=__doc__,
@@ -298,10 +619,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--seed", type=int, default=0,
                     help="search seed; the whole trajectory is a pure "
                     "function of it (default 0)")
+    ap.add_argument("--plane", choices=sorted(SAMPLERS), default="sim",
+                    help="which runner to fuzz: sim (attack campaigns), "
+                    "streaming (serving-plane faults), live (multi-host)")
+    ap.add_argument("--search", choices=("attack", "defense"),
+                    default="attack",
+                    help="attack: hunt red campaign configs; defense: hunt "
+                    "fragile score-parameter configs (sim plane only)")
     ap.add_argument("--defense", choices=sorted(DEFENSES), default="standing",
-                    help="standing score config to fuzz against")
+                    help="standing score config to fuzz against "
+                    "(attack search, sim plane)")
     ap.add_argument("--shrink", action="store_true",
-                    help="minimize the first red config found")
+                    help="minimize the first red config found "
+                    "(attack search)")
     ap.add_argument("--save-red", metavar="PATH",
                     help="write the (minimized, with --shrink) first red "
                     "spec as replayable JSON")
@@ -310,17 +640,67 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = ap.parse_args(argv)
     if args.budget < 1:
         ap.error("--budget must be >= 1")
+    if args.search == "defense" and args.plane != "sim":
+        ap.error("--search defense is a score-parameter hunt; it only "
+                 "exists on the sim plane")
 
-    defense = DEFENSES[args.defense]
+    if args.search == "defense":
+        trajectory = []
+        first_fragile = None
+        for i in range(args.budget):
+            defense = sample_defense(args.seed, i)
+            worst, results = grade_defense(defense)
+            entry = {
+                "index": i,
+                "digest": _digest_obj(defense),
+                "status": worst,
+                "defense": defense,
+                "campaigns": [
+                    {"name": name, "status": status, "failed": failed}
+                    for name, status, failed in results
+                ],
+            }
+            trajectory.append(entry)
+            if not args.json:
+                broke = [c["name"] for c in entry["campaigns"]
+                         if c["status"] != "green"]
+                extra = f"  [{', '.join(broke)}]" if broke else ""
+                print(f"{i:4d}  {entry['digest']}  {worst:<8}{extra}")
+            if worst == "red" and first_fragile is None:
+                first_fragile = entry
+        n_red = sum(e["status"] == "red" for e in trajectory)
+        n_inv = sum(e["status"] == "invalid" for e in trajectory)
+        summary = {
+            "seed": args.seed,
+            "budget": args.budget,
+            "search": "defense",
+            "red": n_red,
+            "green": args.budget - n_red - n_inv,
+            "invalid": n_inv,
+        }
+        if first_fragile is not None:
+            summary["first_fragile_digest"] = first_fragile["digest"]
+        if args.json:
+            print(json.dumps(
+                {"summary": summary, "trajectory": trajectory}, indent=2
+            ))
+        else:
+            print(f"\n{n_red} fragile / {summary['green']} robust / "
+                  f"{n_inv} invalid over {args.budget} defense configs "
+                  f"(seed {args.seed})")
+        return 0
+
+    sampler = SAMPLERS[args.plane]
+    defense = DEFENSES[args.defense] if args.plane == "sim" else None
     trajectory = []
     first_red: Optional[ScenarioSpec] = None
     for i in range(args.budget):
-        spec = sample_spec(args.seed, i, defense)
-        status, verdict, failed = _grade(spec)
+        spec = sampler(args.seed, i, defense)
+        status, verdict, failed = _grade(spec, args.plane)
         entry = {
             "index": i,
             "digest": _digest(spec),
-            "kind": spec.attacks[0].kind,
+            "kind": _spec_kind(spec, args.plane),
             "status": status,
             "failed": failed,
         }
@@ -337,7 +717,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     summary = {
         "seed": args.seed,
         "budget": args.budget,
-        "defense": args.defense,
+        "plane": args.plane,
+        "defense": args.defense if args.plane == "sim" else None,
         "red": n_red,
         "green": args.budget - n_red - n_inv,
         "invalid": n_inv,
@@ -348,7 +729,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         if not args.json:
             print(f"\nshrinking first red ({first_red.name}):")
         minimized = shrink(
-            first_red, (lambda m: None) if args.json else print
+            first_red, (lambda m: None) if args.json else print,
+            plane=args.plane,
         )
         summary["minimized_digest"] = _digest(minimized)
     if args.save_red:
@@ -365,9 +747,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             {"summary": summary, "trajectory": trajectory}, indent=2
         ))
     else:
+        tail = f"defense {args.defense}" if args.plane == "sim" \
+            else f"plane {args.plane}"
         print(f"\n{summary['red']} red / {summary['green']} green / "
               f"{summary['invalid']} invalid over {args.budget} samples "
-              f"(seed {args.seed}, defense {args.defense})")
+              f"(seed {args.seed}, {tail})")
     return 0
 
 
