@@ -387,18 +387,21 @@ def native_verify_window(envs, rng):
     return ok[:N_MSGS], charged, NATIVE_BATCH / dt
 
 
-def device_verify_window(envs, pad_to):
+def device_verify_window(envs, pad_to, batch_major=None):
     """Verify the window's signatures on the TPU device kernel at batch
-    ``pad_to``; returns (verdicts bool[N_MSGS], measured_s, sigs/s)."""
+    ``pad_to``; returns (verdicts bool[N_MSGS], measured_s, sigs/s).
+    ``batch_major=None`` takes the kernel's per-backend default layout;
+    pass False to time the legacy row-major ladder for the layout A/B."""
     from go_libp2p_pubsub_tpu.crypto.pipeline import signing_bytes
     from go_libp2p_pubsub_tpu.ops import ed25519 as dev
 
     pks = [e.pubkey for e in envs]
     msgs = [signing_bytes(e.topic, e.seqno, e.payload) for e in envs]
     sigs = [e.signature for e in envs]
-    dev.verify_batch(pks, msgs, sigs, pad_to=pad_to)  # compile at this shape
+    kw = dict(pad_to=pad_to, batch_major=batch_major)
+    dev.verify_batch(pks, msgs, sigs, **kw)  # compile at this shape
     t0 = time.perf_counter()
-    verdicts = dev.verify_batch(pks, msgs, sigs, pad_to=pad_to)
+    verdicts = dev.verify_batch(pks, msgs, sigs, **kw)
     dt = time.perf_counter() - t0
     # The kernel performs pad_to curve verifications (padding included), so
     # pad_to/dt is the kernel's throughput AT THAT BATCH SIZE.
@@ -518,6 +521,47 @@ def phase_breakdown(gs, st, reps, timer=None):
 
     timeit("hb_px", px_fn, key, st.nbrs, st.rev, st.nbr_valid, st.outbound,
            bo, nm, pr, scores, st.alive)
+
+    # The three prologue kernels above each re-gather the same [N, K] index
+    # planes; the fused path computes (jidx, ridx) once and threads them
+    # through (plus the free px offer bit out of heartbeat_mesh's bitfield
+    # gather).  The honest before/after is chain-vs-chain, so time the
+    # WHOLE scores -> mesh -> px prologue both ways.
+    import jax.numpy as jnp
+
+    def _prologue(fused):
+        def run(k_, counters, gcounters, mesh, nbrs, rev, nbr_valid, eo, al,
+                backoff, outbound, alive):
+            edge_idx = (
+                (jnp.clip(nbrs, 0, gs.n - 1), jnp.clip(rev, 0, gs.k - 1))
+                if fused else None
+            )
+            c = scoring_ops.tick_mesh_clocks(counters, mesh,
+                                             p.heartbeat_interval_s)
+            c = scoring_ops.decay_topic_counters(c, sp)
+            g = scoring_ops.decay_global_counters(gcounters, sp)
+            sc = scoring_ops.neighbor_scores(
+                c, g, nbrs, nbr_valid, sp,
+                jidx=None if edge_idx is None else edge_idx[0],
+            )
+            hb = heartbeat_mesh(
+                k_, mesh, sc, nbrs, rev, eo, al, p, backoff, outbound,
+                False, og_threshold=sp.opportunistic_graft_threshold,
+                edge_idx=edge_idx, with_px_offer=fused,
+            )
+            nm_, _gr, pr_, bo_, _bv = hb[:5]
+            return px_rewire(
+                k_, nbrs, rev, nbr_valid, outbound, bo_, nm_, pr_, sc,
+                alive, sp.accept_px_threshold,
+                edge_idx=edge_idx, offer_ok=hb[5] if fused else None,
+            )
+        return run
+
+    pro_args = (key, st.counters, st.gcounters, st.mesh, st.nbrs, st.rev,
+                st.nbr_valid, edge_ok, part, st.backoff, st.outbound,
+                st.alive)
+    timeit("hb_prologue_unfused", _prologue(False), *pro_args)
+    timeit("hb_prologue_fused", _prologue(True), *pro_args)
 
     # Masks and fanout logic come from the model's own shared helpers
     # (gossip_window_masks / fanout_maintenance), so the profiled kernels
@@ -693,9 +737,34 @@ def sharded_child_main() -> None:
     jax.block_until_ready(st.have_w)
 
     t0 = time.perf_counter()
-    jax.block_until_ready(sg.rollout(st, steps, record=True))
+    # The rollout pin donates its input state, so warm the compile cache on
+    # a throwaway copy and keep ``st`` intact for the measured run.
+    warm = jax.tree.map(jnp.copy, st)
+    jax.block_until_ready(sg.rollout(warm, steps, record=True))
     compile_s = time.perf_counter() - t0
     log(f"compile+warm sharded rollout: {compile_s:.1f}s")
+
+    # Donation accounting straight from the compiled executable: the input
+    # state must ALIAS into the output (one resident state, not two).  XLA
+    # reports per-device sizes, so compare against the argument footprint.
+    mem = (
+        sg._jitted[f"rollout{steps}_True"].lower(st).compile()
+        .memory_analysis()
+    )
+    rollout_mem = {
+        "argument_bytes": int(mem.argument_size_in_bytes),
+        "output_bytes": int(mem.output_size_in_bytes),
+        "temp_bytes": int(mem.temp_size_in_bytes),
+        "alias_bytes": int(mem.alias_size_in_bytes),
+        "state_bytes_total": int(
+            sum(x.nbytes for x in jax.tree.leaves(st))
+        ),
+    }
+    assert rollout_mem["alias_bytes"] >= 0.9 * rollout_mem["argument_bytes"], (
+        f"rollout input state not donated: alias {rollout_mem['alias_bytes']}"
+        f" of argument {rollout_mem['argument_bytes']} bytes"
+    )
+    log(f"rollout memory (per-device bytes): {rollout_mem}")
 
     # Measured run.  Walking the output's addressable shards in device order
     # off the SAME dispatch gives per-device completion times for free.
@@ -752,6 +821,7 @@ def sharded_child_main() -> None:
                 "rollout_s": round(rollout_dt, 2),
                 "per_device_rollout_s": per_device_s,
                 "edge_cut": placement,
+                "rollout_memory": rollout_mem,
                 "phase_split_ms": phases,
                 "flight": flight,
             }
@@ -885,6 +955,36 @@ def rlnc_child_main() -> None:
         },
     }
 
+    # GF(256) matmul micro-bench: log/exp table plane vs the carry-less
+    # int8-dot MXU decomposition on one fixed batched product.  Both paths
+    # are bit-exact (tests/test_rlnc.py); this row records which one the
+    # per-backend default should pick, honestly labeled with the backend
+    # it actually ran on (the MXU path targets TPU systolic arrays and is
+    # expected to LOSE on CPU, where int8 dot_general has no fast path).
+    from go_libp2p_pubsub_tpu.ops import gf256
+
+    def best_ms(fn, *args):
+        f = jax.jit(fn)
+        jax.block_until_ready(f(*args))  # compile
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            jax.block_until_ready(f(*args))
+            best = min(best, time.perf_counter() - t0)
+        return round(best * 1e3, 2)
+
+    gf_shape = (256, 64, 64)
+    rng_g = np.random.default_rng(7)
+    ga = jnp.asarray(rng_g.integers(0, 256, gf_shape, dtype=np.uint8))
+    gb = jnp.asarray(rng_g.integers(0, 256, gf_shape, dtype=np.uint8))
+    gf_bench = {
+        "shape": list(gf_shape),
+        "table_ms": best_ms(gf256.gf_matmul, ga, gb),
+        "mxu_ms": best_ms(gf256.gf_matmul_mxu, ga, gb),
+        "backend": backend,
+    }
+    log(f"gf256_matmul micro-bench (ms): {gf_bench}")
+
     print(
         json.dumps(
             {
@@ -905,6 +1005,7 @@ def rlnc_child_main() -> None:
                     "gossip pend hold (late, lossless)"
                 ),
                 "window_verify_charged_ms": round(verify_dt * 1e3, 2),
+                "gf256_matmul": gf_bench,
                 "clean": sections["clean"],
                 "degraded": sections["degraded"],
             }
@@ -1283,7 +1384,9 @@ def child_main() -> None:
     log(f"native verify: window charged {verify_dt*1e3:.2f} ms "
         f"(128/{NATIVE_BATCH} share of a {native_batch_rate:.0f} sigs/s batch)")
 
-    # Device kernel cross-check + batch-scaling curve (reported, not charged).
+    # Device kernel cross-check + batch-scaling curve (reported, not
+    # charged).  The curve runs the kernel's per-backend default layout
+    # (batch-major since r15: limbs lead, batch rides the 128-lane axis).
     device_curve = {}
     for pad in scale["device_curve"]:
         t0 = time.perf_counter()
@@ -1294,6 +1397,30 @@ def child_main() -> None:
         assert bool(np.all(np.asarray(dv) == expected)), (
             f"device verdicts disagree with native at batch {pad}"
         )
+    # Batch knee: smallest batch reaching >=90% of the curve's peak rate —
+    # below it the lanes are underfed, above it throughput is flat.
+    peak_rate = max(device_curve.values())
+    device_batch_knee = min(
+        int(k) for k, v in device_curve.items() if v >= 0.9 * peak_rate
+    )
+    log(f"device ed25519 batch knee: {device_batch_knee} "
+        f"(peak {peak_rate:.0f} sigs/s)")
+    # Layout A/B at the smallest curve point: the legacy row-major ladder
+    # vs the batch-major default, same inputs, verdict-checked both ways.
+    ab_pad = scale["device_curve"][0]
+    dv_rm, dt_rm, rate_rm = device_verify_window(envs, ab_pad,
+                                                 batch_major=False)
+    assert bool(np.all(np.asarray(dv_rm) == expected)), (
+        "row-major device verdicts disagree with native"
+    )
+    device_layout_ab = {
+        "batch": ab_pad,
+        "rowmajor_sigs_per_sec": round(rate_rm, 1),
+        "batchmajor_sigs_per_sec": device_curve[str(ab_pad)],
+    }
+    log(f"device ed25519 layout A/B @ batch {ab_pad}: "
+        f"row-major {rate_rm:.1f} vs batch-major "
+        f"{device_curve[str(ab_pad)]:.1f} sigs/s")
 
     # Config (c) native rate: the batch native_verify_window already timed
     # (a second full sign+verify of 8192 would measure the same thing twice).
@@ -1453,6 +1580,8 @@ def child_main() -> None:
                 "scenario_smoke": scenario_verdict,
                 "scenario_canon": scenario_canon,
                 "ed25519_device_scaling": device_curve,
+                "ed25519_batch_knee": device_batch_knee,
+                "ed25519_layout_ab": device_layout_ab,
                 "ed25519_native_sigs_per_sec": round(native_sigs_per_sec, 1),
                 "treecast_10peer_deliveries_per_sec": round(tree_msgs_per_sec, 1),
                 "scoring_heartbeat_ms": scoring_ms,

@@ -333,6 +333,7 @@ class GossipSub:
         direct_edges: Optional[np.ndarray] = None,
         peer_uid: Optional[np.ndarray] = None,
         split_gather_mesh=None,
+        fused_prologue: Optional[bool] = None,
     ):
         self.n = n_peers
         self.k = n_slots
@@ -406,6 +407,17 @@ class GossipSub:
         # through shard-local indexing + an overlapped ppermute ring instead
         # of one monolithic all-shard gather.
         self.split_gather_mesh = split_gather_mesh
+        # Fused heartbeat prologue: share ONE clipped (jidx, ridx) pair and
+        # ONE slot-pairing bitfield gather across the heartbeat's three
+        # prologue kernels (neighbor_scores / heartbeat_mesh / px_rewire)
+        # instead of each re-deriving its own — PX's [N, K] score gather
+        # rides heartbeat_mesh's existing flags word.  Bit-exact with the
+        # unfused chain (asserted leaf-for-leaf in tests); default ON
+        # everywhere — it strictly removes work, and the win grows with N
+        # on TPU where per-element gathers are latency-bound.
+        if fused_prologue is None:
+            fused_prologue = True
+        self.fused_prologue = bool(fused_prologue)
 
     # Value semantics for the jit cache: the model is a pure function of
     # its configuration, so two identically-configured instances may share
@@ -423,7 +435,7 @@ class GossipSub:
         return (
             type(self), self.n, self.k, self.m, self.conn_degree,
             self.params, self.score_params, self.heartbeat_steps,
-            self.use_pallas, self.max_edge_delay,
+            self.use_pallas, self.max_edge_delay, self.fused_prologue,
             None if self.graft_spammers is None
             else bytes(np.asarray(self.graft_spammers)),
             None if self.direct_edges is None
@@ -807,11 +819,24 @@ class GossipSub:
         p, sp = self.params, self.score_params
         khb, kgossip, kiwant, kfan, kpx, knext = jax.random.split(st.key, 6)
 
+        # Fused prologue (default): ONE clipped (jidx, ridx) slot-pairing
+        # index pair shared by the three prologue kernels below; px_rewire
+        # additionally reuses heartbeat_mesh's bitfield gather for its
+        # offer gate.  The unfused branch keeps each kernel self-contained
+        # and is the bit-exactness reference.
+        edge_idx = (
+            (jnp.clip(st.nbrs, 0, self.n - 1), jnp.clip(st.rev, 0, self.k - 1))
+            if self.fused_prologue else None
+        )
+
         # Advance mesh clocks by one heartbeat interval; decay; re-score.
         c = scoring_ops.tick_mesh_clocks(st.counters, st.mesh, p.heartbeat_interval_s)
         c = scoring_ops.decay_topic_counters(c, sp)
         g = scoring_ops.decay_global_counters(st.gcounters, sp)
-        scores = scoring_ops.neighbor_scores(c, g, st.nbrs, st.nbr_valid, sp)
+        scores = scoring_ops.neighbor_scores(
+            c, g, st.nbrs, st.nbr_valid, sp,
+            jidx=None if edge_idx is None else edge_idx[0],
+        )
 
         # Topic participation: mesh forms only between alive+subscribed
         # endpoints (the model folds subscription into the liveness view the
@@ -826,13 +851,17 @@ class GossipSub:
         hb_idx = st.step // self.heartbeat_steps
         do_og = (hb_idx % p.opportunistic_graft_ticks) == 0
 
-        new_mesh, grafted, pruned, backoff, bo_violations = heartbeat_mesh(
+        hb_out = heartbeat_mesh(
             khb, st.mesh, scores, st.nbrs, st.rev, edge_ok, part, p,
             st.backoff, st.outbound, do_og,
             og_threshold=sp.opportunistic_graft_threshold,
             ignore_backoff=self.graft_spammers,
             uid=self.peer_uid,
+            edge_idx=edge_idx,
+            with_px_offer=self.fused_prologue,
         )
+        new_mesh, grafted, pruned, backoff, bo_violations = hb_out[:5]
+        px_offer_ok = hb_out[5] if self.fused_prologue else None
         c = scoring_ops.on_prune(c, pruned, sp)
         c = scoring_ops.on_graft(c, grafted)
         # P7: charge backoff-violating GRAFT attempts to their sender; the
@@ -849,6 +878,8 @@ class GossipSub:
             kpx, st.nbrs, st.rev, st.nbr_valid, st.outbound, backoff,
             new_mesh, pruned, scores, st.alive, sp.accept_px_threshold,
             uid=self.peer_uid,
+            edge_idx=edge_idx,
+            offer_ok=px_offer_ok,
         )
         edge_live, nbr_sub = jax.lax.cond(
             px.connected.any(),

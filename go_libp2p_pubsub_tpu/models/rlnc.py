@@ -106,11 +106,20 @@ class RLNC:
         gen_size: int = 8,
         builder=None,
         peer_uid: Optional[np.ndarray] = None,
+        use_mxu: Optional[bool] = None,
     ):
         if gen_size < 1:
             raise ValueError("gen_size must be >= 1")
         if gen_size > 255:
             raise ValueError("gen_size must be <= 255 (GF(256) coefficients)")
+        # use_mxu routes the encode combination through the carry-less
+        # int8-dot decomposition (``gf256.gf_combine_mxu``) instead of the
+        # table lookups — bit-exact either way, so the default follows the
+        # proven-faster path per backend: the MXU form exists for the
+        # systolic array, the table form wins on CPU (PERF.md r15).
+        if use_mxu is None:
+            use_mxu = jax.default_backend() == "tpu"
+        self.use_mxu = bool(use_mxu)
         self.n = n_peers
         self.k = n_slots
         self.m = msg_window       # generations in flight (the window)
@@ -134,7 +143,7 @@ class RLNC:
             return id(self)
         return (
             type(self), self.n, self.k, self.m, self.gen_size,
-            self.conn_degree,
+            self.conn_degree, self.use_mxu,
             None if self.peer_uid is None
             else bytes(np.asarray(self.peer_uid)),
         )
@@ -307,7 +316,8 @@ class RLNC:
         coeffs = gf256.coeffs_by_uid(
             key_c, (n, k, g, kg), self.peer_uid
         )                                                   # u8[N, K, G, Kg]
-        frag_out = gf256.gf_combine(
+        combine = gf256.gf_combine_mxu if self.use_mxu else gf256.gf_combine
+        frag_out = combine(
             coeffs, st.basis[:, None]
         )                                                   # u8[N, K, G, Kg]
 

@@ -18,11 +18,19 @@ the antilog table doubled to 510 entries so the hot path needs no mod-255)
 — on device that is two integer gathers and a table lookup per product,
 with the zero cases masked (log(0) is undefined; anything times 0 is 0).
 
-Honesty note (PERF.md r11): this is the *table-lookup* formulation — XLA
-lowers the products to gathers on the VPU, not MXU int8 matmuls.  The true
-MXU decomposition (carry-less 8x8-bit products via int8 dot-products plus
-a polynomial-reduction pass) is future work; the shapes here are already
-matmul-shaped so only the inner product kernel would change.
+Two formulations of the matmul coexist (PERF.md r11/r15):
+
+- ``gf_matmul``/``gf_combine`` — the *table-lookup* form: XLA lowers the
+  products to integer gathers on the VPU.  Cheap per-element on CPU, but
+  never touches the MXU.
+- ``gf_matmul_mxu``/``gf_combine_mxu`` — the *carry-less decomposition*:
+  each operand splits into its 8 bit planes, one int8 ``dot_general``
+  counts the per-bit-pair overlaps across the contraction axis (the
+  integer count's PARITY is the XOR-accumulated carry-less product bit),
+  and the 15 polynomial coefficient planes fold back to bytes through the
+  precomputed residues ``x^t mod 0x11B``.  Bit-exact with the table path
+  (both are exact field arithmetic; asserted over the exhaustive 256x256
+  product table in ``tests/test_rlnc.py``), selected by ``RLNC(use_mxu=)``.
 """
 
 from __future__ import annotations
@@ -107,6 +115,58 @@ def gf_matmul(a: jax.Array, b: jax.Array) -> jax.Array:
     return jax.lax.reduce(
         prod, np.uint8(0), jax.lax.bitwise_xor, dimensions=(prod.ndim - 2,)
     )
+
+
+# Residues x^t mod 0x11B for t = 8..14: where the high coefficient planes of
+# the 15-term carry-less product land after polynomial reduction.
+_MXU_REDUCE = (0x1B, 0x36, 0x6C, 0xD8, 0xAB, 0x4D, 0x9A)
+
+
+def gf_matmul_mxu(a: jax.Array, b: jax.Array) -> jax.Array:
+    """:func:`gf_matmul` on the MXU: u8[..., M, K] x u8[..., K, N] ->
+    u8[..., M, N], bit-exact with the table path.
+
+    Decomposition: a GF(256) product is a carry-less (GF(2)[x]) 8x8-bit
+    polynomial product followed by reduction mod 0x11B, and XOR
+    accumulation over the contraction axis commutes with both.  Coefficient
+    ``t`` of the accumulated carry-less product is the PARITY of
+    ``sum_k sum_{i+j=t} a_i[m,k] * b_j[k,n]`` over the bit planes
+    ``a_i = (a >> i) & 1`` — an integer bit-plane dot product.  One int8
+    ``dot_general`` (the einsum below) computes all 64 plane-pair counts;
+    int8 x int8 -> int32 contractions are the MXU's native shape, so this
+    is the formulation that rides the systolic array instead of the VPU
+    gather unit.  On CPU the 64 tiny matmuls usually LOSE to the table
+    lookups — the flag defaults per backend (``models/rlnc.py``).
+    """
+    ap = (
+        (a[..., None, :, :] >> jnp.arange(8, dtype=jnp.uint8)[:, None, None])
+        & jnp.uint8(1)
+    ).astype(jnp.int8)                                  # [..., 8, M, K]
+    bp = (
+        (b[..., None, :, :] >> jnp.arange(8, dtype=jnp.uint8)[:, None, None])
+        & jnp.uint8(1)
+    ).astype(jnp.int8)                                  # [..., 8, K, N]
+    counts = jnp.einsum(
+        "...imk,...jkn->...ijmn", ap, bp,
+        preferred_element_type=jnp.int32,
+    )                                                   # [..., 8, 8, M, N]
+    acc = None
+    for t in range(15):
+        tot = None
+        for i in range(max(0, t - 7), min(7, t) + 1):
+            c = counts[..., i, t - i, :, :]
+            tot = c if tot is None else tot + c
+        par = (tot & 1).astype(jnp.uint8)               # coefficient plane t
+        w = jnp.uint8((1 << t) if t < 8 else _MXU_REDUCE[t - 8])
+        term = par * w
+        acc = term if acc is None else acc ^ term
+    return acc
+
+
+def gf_combine_mxu(coeffs: jax.Array, rows: jax.Array) -> jax.Array:
+    """:func:`gf_combine` through the MXU matmul: the encode kernel as a
+    [1, K] x [K, L] byte product (same broadcasting contract)."""
+    return gf_matmul_mxu(coeffs[..., None, :], rows)[..., 0, :]
 
 
 def coeffs_by_uid(

@@ -325,7 +325,9 @@ def heartbeat_mesh(
     og_threshold: float = 1.0,  # ScoreParams.opportunistic_graft_threshold
     ignore_backoff: Optional[jax.Array] = None,  # bool[N] misbehaviour model
     uid: Optional[jax.Array] = None,  # i32[N] canonical id per physical row
-) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    edge_idx: Optional[Tuple[jax.Array, jax.Array]] = None,  # shared (jidx, ridx)
+    with_px_offer: bool = False,
+) -> Tuple[jax.Array, ...]:
     """Mesh maintenance: prune negative-score and over-degree links, graft
     toward D from well-scored candidates, then symmetrize edge state.
 
@@ -335,6 +337,16 @@ def heartbeat_mesh(
     inside the remote's prune-backoff window.  The spec's P7 behaviour
     penalty charges exactly these; the model feeds them into
     ``GlobalCounters.behaviour_penalty``.
+
+    Fused-prologue hooks: ``edge_idx`` optionally supplies the clipped
+    ``(jidx, ridx)`` slot-pairing indices the caller shares across the
+    heartbeat's three prologue kernels (scores / mesh / PX), and
+    ``with_px_offer=True`` appends a sixth output — ``score_rev_ok``
+    bool[N, K], the remote's view ``(scores >= 0)[jidx, ridx]`` that
+    :func:`..px.px_rewire` would otherwise re-gather as its PX offer gate.
+    The plane already rides this kernel's single bitfield gather (bit 2),
+    so returning it is free; gather-then-compare and compare-then-gather
+    are the same booleans, so the handoff is bit-exact.
 
     A spec-following peer never attempts such a graft (its own candidacy is
     gated by the same — symmetric — backoff countdown), so honest rows are
@@ -472,8 +484,11 @@ def heartbeat_mesh(
     # a per-slot array at [jidx, ridx] reads the remote's view of this same
     # edge.  Per-element gathers are latency-bound on TPU (~tens of ms at
     # 100k peers), so the four remote views ride ONE int32 bitfield gather.
-    jidx = jnp.clip(nbrs, 0, n - 1)
-    ridx = jnp.clip(rev, 0, k - 1)
+    if edge_idx is None:
+        jidx = jnp.clip(nbrs, 0, n - 1)
+        ridx = jnp.clip(rev, 0, k - 1)
+    else:
+        jidx, ridx = edge_idx
     flags = (
         keep.astype(jnp.int32)
         | (graft.astype(jnp.int32) << 1)
@@ -512,4 +527,9 @@ def heartbeat_mesh(
     # P7-chargeable misbehaviour (zero for spec-following peers, whose own
     # symmetric countdown gates candidacy).
     bo_violations = (graft & ~bo_rev_ok).sum(axis=1).astype(jnp.float32)
+    if with_px_offer:
+        return (
+            new_mesh, grafted, pruned, new_backoff, bo_violations,
+            score_rev_ok,
+        )
     return new_mesh, grafted, pruned, new_backoff, bo_violations

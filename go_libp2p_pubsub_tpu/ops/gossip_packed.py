@@ -105,6 +105,19 @@ def _as_mask(b: jax.Array) -> jax.Array:
     return jnp.where(b, FULL, jnp.uint32(0))
 
 
+def _gather_packed_bits(
+    plane: jax.Array, jidx: jax.Array, ridx: jax.Array
+) -> jax.Array:
+    """``plane[jidx, ridx]`` for a bool[N, K] plane, gathered bit-packed:
+    pack along the slot axis (u32[N, ceil(K/32)]), gather one word per
+    edge, extract the bit.  Same element count as the bool gather but an
+    8x smaller table (and one word per peer when K <= 32) — the packed
+    path's word-plane discipline for bool planes crossing a gather."""
+    words = bitpack.pack(plane)                      # u32[N, ceil(K/32)]
+    w = words[jidx, ridx // 32]
+    return ((w >> (ridx % 32).astype(jnp.uint32)) & 1) > 0
+
+
 def exclusive_or_scan(x: jax.Array, axis: int) -> jax.Array:
     """Exclusive cumulative bitwise-OR along ``axis`` (log-step prefix)."""
     k = x.shape[axis]
@@ -236,9 +249,13 @@ def ihave_advertise_packed(
         key, mesh, edge_live, alive, scores, p, gossip_threshold, uid
     )
     # Target side: neighbor j = nbrs[t, s] chose me iff chosen[j, rev[t, s]].
+    # The chooser plane crosses the gather BIT-PACKED along the slot axis
+    # (u32[N, ceil(K/32)] instead of bool[N, K] — the ring path's idiom,
+    # r10): the gathered table is 8x smaller and, for K <= 32, the slot
+    # lookup folds into a shift off a single word per edge.  Bit-exact.
     jidx = jnp.clip(nbrs, 0, n - 1)
     ridx = jnp.clip(rev, 0, k - 1)
-    towards_me = chosen[jidx, ridx] & edge_live                    # bool[N, K]
+    towards_me = _gather_packed_bits(chosen, jidx, ridx) & edge_live
     adv = _as_mask(towards_me)[:, :, None] & (have_w & gossip_w[None, :])[jidx]
     return cap_ihave_packed(adv, p.max_ihave_length)
 
@@ -346,7 +363,9 @@ def gossip_exchange_packed(
     ridx_p = take(jnp.clip(rev, 0, k - 1))
     edge_live_p = take(edge_live)
     if device_mesh is None:
-        towards_me_p = chosen[jidx_p, ridx_p] & edge_live_p
+        # Chooser bits gather bit-packed (see _gather_packed_bits) — the
+        # monolithic twin of the ring path's concatenated packed plane.
+        towards_me_p = _gather_packed_bits(chosen, jidx_p, ridx_p) & edge_live_p
         rows_p = (have_w & gossip_w[None, :])[jidx_p]
     else:
         w = have_w.shape[1]

@@ -27,7 +27,7 @@ hot path.
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -57,16 +57,29 @@ def px_rewire(
     alive: jax.Array,      # bool[N]
     accept_px_threshold: float,
     uid: Optional[jax.Array] = None,  # i32[N] canonical id per physical row
+    edge_idx: Optional[Tuple[jax.Array, jax.Array]] = None,  # shared (jidx, ridx)
+    offer_ok: Optional[jax.Array] = None,  # bool[N, K] precomputed offer gate
 ) -> PxOut:
     """One PX round: each pruned peer may open one new connection to a
-    random mesh neighbor of its pruner.  Returns the rewired adjacency."""
+    random mesh neighbor of its pruner.  Returns the rewired adjacency.
+
+    ``edge_idx`` / ``offer_ok`` are the fused-prologue hooks: the heartbeat
+    shares one clipped ``(jidx, ridx)`` pair across its prologue kernels,
+    and ``heartbeat_mesh(..., with_px_offer=True)`` already gathered the
+    pruner's ``scores >= 0`` view on its bitfield gather — passing it here
+    skips this kernel's only [N, K] slot-pairing gather (bit-exact: the
+    compare commutes with the gather)."""
     n, k = nbrs.shape
-    jidx = jnp.clip(nbrs, 0, n - 1)
-    ridx = jnp.clip(rev, 0, k - 1)
+    if edge_idx is None:
+        jidx = jnp.clip(nbrs, 0, n - 1)
+        ridx = jnp.clip(rev, 0, k - 1)
+    else:
+        jidx, ridx = edge_idx
     peer_ids = jnp.arange(n, dtype=jnp.int32)
 
     # Which pruned slots carry an acceptable PX offer.
-    offer_ok = scores[jidx, ridx] >= 0.0          # pruner j offers (its view of me)
+    if offer_ok is None:
+        offer_ok = scores[jidx, ridx] >= 0.0      # pruner j offers (its view of me)
     accept_ok = scores >= accept_px_threshold     # I trust pruner j enough
     px_edge = pruned & offer_ok & accept_ok & nbr_valid
     has_px = px_edge.any(axis=1)

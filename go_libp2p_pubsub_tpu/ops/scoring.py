@@ -15,7 +15,7 @@ vectorized reductions over the slot axis.
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -118,14 +118,20 @@ def neighbor_scores(
     nbrs: jax.Array,
     nbr_valid: jax.Array,
     p: ScoreParams,
+    jidx: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Full score of each neighbor slot -> f32[N, K].
 
     ``nbrs`` i32[N, K] maps slots to remote peer ids; invalid slots score
-    -inf so top-k selections never pick them.
+    -inf so top-k selections never pick them.  ``jidx`` optionally supplies
+    the clipped neighbor-id plane (``clip(nbrs, 0, N-1)``) when the caller
+    already computed it for the heartbeat's other kernels (the fused
+    prologue shares one clip across scores/mesh/PX).
     """
     gs = global_score(g, p)  # f32[N] by remote id
-    remote = gs[jnp.clip(nbrs, 0, gs.shape[0] - 1)]
+    if jidx is None:
+        jidx = jnp.clip(nbrs, 0, gs.shape[0] - 1)
+    remote = gs[jidx]
     total = topic_score(c, p) + remote
     return jnp.where(nbr_valid, total, -jnp.inf)
 

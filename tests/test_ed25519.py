@@ -178,6 +178,109 @@ def test_device_rejects_malleable_and_noncanonical():
 
 
 # ---------------------------------------------------------------------------
+# batch-major (limb-major) kernel vs row-major kernel
+# ---------------------------------------------------------------------------
+
+# RFC 8032 §7.1 test vectors: (secret, public, msg, sig), hex.
+_RFC8032 = [
+    (  # TEST 1 (empty message)
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60",
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+        "",
+        "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+        "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b",
+    ),
+    (  # TEST 2 (one byte)
+        "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb",
+        "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+        "72",
+        "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+        "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00",
+    ),
+    (  # TEST 3 (two bytes)
+        "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7",
+        "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+        "af82",
+        "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+        "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a",
+    ),
+    (  # TEST SHA(abc)
+        "833fe62409237b9d62ec77587520911e9a759cec1d19755b7da901b96dca3d42",
+        "ec172b93ad5e563bf4932c70e1245034c35467ef2efd4d64ebf819683467e2bf",
+        "ddaf35a193617abacc417349ae20413112e6fa4e89a97ea20a9eeee64b55d39a"
+        "2192992a274fc1a836ba3c23a3feebbd454d4423643ce80e2a9ac94fa54ca49f",
+        "dc2a4459e7369633a52b1bf277839a00201009a3efbf3ecb69bea2186c26b589"
+        "09351fc9ac90b3ecfdfbc7c66431e0303dca179c138ac17ad9bef1177331a704",
+    ),
+]
+
+
+def test_device_rfc8032_vectors_both_layouts():
+    """RFC 8032 §7.1 vectors accept (and corrupted variants reject) under
+    BOTH kernel layouts, with identical verdict vectors."""
+    from go_libp2p_pubsub_tpu.ops import ed25519 as dev
+
+    pks, msgs, sigs = [], [], []
+    for sk_h, pk_h, msg_h, sig_h in _RFC8032:
+        sk, pk = bytes.fromhex(sk_h), bytes.fromhex(pk_h)
+        msg, sig = bytes.fromhex(msg_h), bytes.fromhex(sig_h)
+        assert ref.public_key(sk) == pk and ref.sign(sk, msg) == sig
+        pks.append(pk)
+        msgs.append(msg)
+        sigs.append(sig)
+    # two corrupted rows ride along: flipped sig bit, flipped pubkey bit
+    pks.append(pks[0])
+    msgs.append(msgs[0])
+    sigs.append(bytes([sigs[0][0] ^ 1]) + sigs[0][1:])
+    pks.append(bytes([pks[1][0] ^ 1]) + pks[1][1:])
+    msgs.append(msgs[1])
+    sigs.append(sigs[1])
+
+    want = np.array([True] * 4 + [False] * 2)
+    rm = dev.verify_batch(pks, msgs, sigs, pad_to=8, batch_major=False)
+    bm = dev.verify_batch(pks, msgs, sigs, pad_to=8, batch_major=True)
+    np.testing.assert_array_equal(rm, want)
+    np.testing.assert_array_equal(bm, rm)
+
+
+@pytest.mark.slow
+def test_device_batch_major_bit_exact_sweep():
+    """256-signature sweep (valid / corrupt sig / corrupt msg / corrupt pk /
+    malleable S / non-canonical R mix): the batch-major kernel's verdict
+    vector is bit-identical to the row-major kernel's and to the oracle."""
+    from go_libp2p_pubsub_tpu.ops import ed25519 as dev
+
+    rng = np.random.default_rng(20260805)
+    n = 256
+    seeds, msgs, pks, sigs = _rand_batch(n, msg_len=32, seed=99)
+    msgs, pks, sigs = list(msgs), list(pks), list(sigs)
+    for i in range(n):
+        kind = i % 8
+        if kind == 1:  # corrupt a signature bit
+            b = bytearray(sigs[i])
+            b[rng.integers(0, 64)] ^= 1 << rng.integers(0, 8)
+            sigs[i] = bytes(b)
+        elif kind == 3:  # corrupt the message
+            msgs[i] = msgs[i] + b"\x00"
+        elif kind == 5:  # corrupt the pubkey
+            b = bytearray(pks[i])
+            b[rng.integers(0, 32)] ^= 1 << rng.integers(0, 8)
+            pks[i] = bytes(b)
+        elif kind == 7 and i % 16 == 7:  # malleable S = s + L
+            s_plus_l = int.from_bytes(sigs[i][32:], "little") + ref.L
+            sigs[i] = sigs[i][:32] + s_plus_l.to_bytes(32, "little")
+        elif kind == 7:  # non-canonical R (y >= p)
+            sigs[i] = (ref.P + 3).to_bytes(32, "little") + sigs[i][32:]
+
+    oracle = np.array([ref.verify(p, m, s) for p, m, s in zip(pks, msgs, sigs)])
+    rm = dev.verify_batch(pks, msgs, sigs, batch_major=False)
+    bm = dev.verify_batch(pks, msgs, sigs, batch_major=True)
+    np.testing.assert_array_equal(rm, oracle)
+    np.testing.assert_array_equal(bm, rm)
+    assert oracle.any() and not oracle.all()
+
+
+# ---------------------------------------------------------------------------
 # pipeline
 # ---------------------------------------------------------------------------
 

@@ -51,8 +51,12 @@ def test_sharded_rollout_delivers(sharded):
 
 
 def test_sharded_matches_unsharded_bitwise(sharded):
+    # The unsharded reference runs the UNFUSED heartbeat prologue while the
+    # sharded model keeps the fused default — one bit-equality sweep covers
+    # both GSPMD partitioning and the fused-prologue gather rewrite.
     gs = GossipSub(
-        n_peers=256, n_slots=16, conn_degree=8, msg_window=32, use_pallas=False
+        n_peers=256, n_slots=16, conn_degree=8, msg_window=32,
+        use_pallas=False, fused_prologue=False,
     )
     sa = gs.init(seed=9)
     sb = sharded.init(seed=9)

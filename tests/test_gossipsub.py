@@ -292,6 +292,33 @@ def test_idontwant_model_cuts_duplicates_only():
         )
 
 
+def test_fused_prologue_rollout_bit_identical():
+    """The fused heartbeat prologue (shared (jidx, ridx) clip + px_rewire
+    riding heartbeat_mesh's bitfield gather) is leaf-for-leaf identical to
+    the unfused chain over a recorded rollout — state AND flight-recorder
+    channels, with enough steps to cross several heartbeats, prunes, and
+    PX rewires."""
+    import jax
+
+    kw = dict(n_peers=96, n_slots=16, conn_degree=8, msg_window=64,
+              heartbeat_steps=4, use_pallas=False)
+    ga = GossipSub(fused_prologue=False, **kw)
+    gb = GossipSub(fused_prologue=True, **kw)
+    assert ga != gb and hash(ga) != hash(gb)  # flag must key the jit cache
+    sa, sb = ga.init(seed=3), gb.init(seed=3)
+    for s in range(4):
+        sa = ga.publish(sa, jnp.int32(s * 7), jnp.int32(s), jnp.asarray(True))
+        sb = gb.publish(sb, jnp.int32(s * 7), jnp.int32(s), jnp.asarray(True))
+    sa, ra = ga.rollout(sa, 40, record=True)
+    sb, rb = gb.rollout(sb, 40, record=True)
+    la, lb = jax.tree.leaves(sa), jax.tree.leaves(sb)
+    assert len(la) == len(lb)
+    for xa, xb in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+    for cha, chb in zip(jax.tree.leaves(ra), jax.tree.leaves(rb)):
+        np.testing.assert_array_equal(np.asarray(cha), np.asarray(chb))
+
+
 def test_idontwant_wire_lag_weakens_suppression_only():
     """``idontwant_wire_lag=True`` snapshots possession one round older
     (wire parity: an IDONTWANT for a message received this round cannot

@@ -101,6 +101,63 @@ def test_gf_matmul_and_combine_match_reference():
     assert (got == ref).all()
 
 
+def test_gf_matmul_mxu_exhaustive_product_table():
+    """The carry-less int8-dot decomposition must agree with the table path
+    on ALL 65,536 ordered byte pairs — one [256, 1] x [1, 256] product whose
+    output IS the full multiplication table (ISSUE 10 acceptance)."""
+    a = jnp.asarray(np.arange(256, dtype=np.uint8)[:, None])
+    b = jnp.asarray(np.arange(256, dtype=np.uint8)[None, :])
+    table = np.asarray(gf256.gf_matmul(a, b))
+    mxu = np.asarray(gf256.gf_matmul_mxu(a, b))
+    np.testing.assert_array_equal(mxu, table)
+    # Spot-anchor against the table-free peasant reference too.
+    ii, jj = np.meshgrid(np.arange(256), np.arange(256), indexing="ij")
+    np.testing.assert_array_equal(
+        table, ref_mul(ii.astype(np.uint8), jj.astype(np.uint8))
+    )
+
+
+def test_gf_matmul_mxu_batched_and_combine_broadcast():
+    """Batched shapes and the encode kernel's broadcast contract
+    (coeffs [..., K] against rows [..., 1, ..., K, L]) stay bit-exact."""
+    rng = np.random.default_rng(7)
+    a = jnp.asarray(rng.integers(0, 256, (3, 5, 6), dtype=np.uint8))
+    b = jnp.asarray(rng.integers(0, 256, (3, 6, 4), dtype=np.uint8))
+    np.testing.assert_array_equal(
+        np.asarray(gf256.gf_matmul_mxu(a, b)),
+        np.asarray(gf256.gf_matmul(a, b)),
+    )
+    # The RLNC encode shape: coeffs u8[N, K, G, Kg] x basis u8[N, 1, G, Kg, Kg].
+    c = jnp.asarray(rng.integers(0, 256, (6, 4, 3, 8), dtype=np.uint8))
+    r = jnp.asarray(rng.integers(0, 256, (6, 1, 3, 8, 8), dtype=np.uint8))
+    np.testing.assert_array_equal(
+        np.asarray(gf256.gf_combine_mxu(c, r)),
+        np.asarray(gf256.gf_combine(c, r)),
+    )
+
+
+def test_rlnc_mxu_flag_rollout_bit_identical():
+    """RLNC(use_mxu=True) is a pure kernel swap: state leaves and every
+    flight-recorder channel bit-match the table path, and the flag enters
+    the model's value identity (distinct jit cache entries)."""
+    from go_libp2p_pubsub_tpu.models.rlnc import RLNC
+
+    kw = dict(n_peers=24, n_slots=8, conn_degree=4, msg_window=6, gen_size=3)
+    ta = RLNC(use_mxu=False, **kw)
+    mx = RLNC(use_mxu=True, **kw)
+    assert ta != mx and hash(ta) != hash(mx)
+    sa, sb = ta.init(seed=1), mx.init(seed=1)
+    sa = ta.publish(sa, jnp.int32(0), jnp.int32(0), jnp.asarray(True))
+    sb = mx.publish(sb, jnp.int32(0), jnp.int32(0), jnp.asarray(True))
+    fa, ra = ta.rollout(sa, 8, record=True)
+    fb, rb = mx.rollout(sb, 8, record=True)
+    for la, lb in zip(jax.tree.leaves(fa), jax.tree.leaves(fb)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    assert set(ra) == set(rb)
+    for ch in ra:
+        np.testing.assert_array_equal(np.asarray(ra[ch]), np.asarray(rb[ch]))
+
+
 # ---------------------------------------------------------------------------
 # encode/decode: streaming elimination + full solve
 # ---------------------------------------------------------------------------

@@ -73,6 +73,31 @@ def test_all_families_trace_smoke():
     )
     jax.eval_shape(rl.step, rl_st)
 
+    # -- perf flags: ISSUE 10's three restructured hot paths must TRACE ----
+    # (flag rot — a renamed field or broken shape inside a flag-gated
+    # branch — fails here in seconds without compiling the slow benches).
+    g_fused = GossipSub(
+        n_peers=16, n_slots=8, conn_degree=4, msg_window=4,
+        use_pallas=False, fused_prologue=True,
+    )
+    jax.eval_shape(g_fused.step, jax.eval_shape(lambda: g_fused.init(seed=0)))
+
+    rl_mxu = RLNC(
+        n_peers=16, n_slots=8, conn_degree=4, msg_window=4, gen_size=2,
+        use_mxu=True,
+    )
+    jax.eval_shape(rl_mxu.step, jax.eval_shape(lambda: rl_mxu.init(seed=0)))
+
+    from go_libp2p_pubsub_tpu.ops import ed25519 as ed
+
+    def _bm_kernel():
+        z2 = jnp.zeros((4, ed.LIMBS), jnp.int32)
+        z1 = jnp.zeros((4,), jnp.int32)
+        zb = jnp.zeros((4, 256), jnp.int32)
+        return ed._verify_kernel_bm(z2, z1, z2, z1, zb, zb)
+
+    assert jax.eval_shape(_bm_kernel).shape == (4,)
+
     # -- treecast / floodsub (cheap anyway, but keep the tier complete) ----
     from go_libp2p_pubsub_tpu.config import SimParams, TreeOpts
     from go_libp2p_pubsub_tpu.models.floodsub import FloodSub

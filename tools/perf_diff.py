@@ -124,6 +124,22 @@ SCALAR_ROWS: List[Tuple[Tuple[str, ...], str, bool]] = [
     # as sharded/rlnc/streaming when a record lacks it.
     (("scenario_canon", "count"), "canon scenario count", True),
     (("scenario_canon", "attack_count"), "canon attack campaigns", True),
+    # Hardware-shape restructure rows (r15+): ed25519 batch knee (smallest
+    # batch at >=90% of peak — lower means the lanes fill earlier), the
+    # row-major vs batch-major layout A/B, the GF(256) table-vs-MXU
+    # micro-bench, and the donated sharded-rollout memory accounting.
+    # Records that predate r15 just show "-" plus a header warning.
+    (("ed25519_batch_knee",), "device ed25519 batch knee", False),
+    (("ed25519_layout_ab", "rowmajor_sigs_per_sec"),
+     "device ed25519 row-major sigs/s", True),
+    (("ed25519_layout_ab", "batchmajor_sigs_per_sec"),
+     "device ed25519 batch-major sigs/s", True),
+    (("rlnc", "gf256_matmul", "table_ms"), "gf256 matmul table (ms)", False),
+    (("rlnc", "gf256_matmul", "mxu_ms"), "gf256 matmul mxu (ms)", False),
+    (("sharded", "rollout_memory", "temp_bytes"),
+     "sharded rollout temp (bytes/device)", False),
+    (("sharded", "rollout_memory", "alias_bytes"),
+     "sharded rollout aliased (bytes/device)", True),
 ]
 
 
@@ -321,6 +337,31 @@ def context_warnings(old: Dict[str, Any], new: Dict[str, Any]) -> List[str]:
                     f"(missing in {which}; added in r14) — its rows are "
                     f"one-sided"
                 )
+    # Hardware-shape restructure keys (r15+): presence mismatch means one
+    # record predates the batch-major/fused-prologue/MXU round — the
+    # affected rows are one-sided, not a crash.
+    for key in ("ed25519_batch_knee", "ed25519_layout_ab"):
+        if (key in old) != (key in new):
+            which = "old" if key not in old else "new"
+            warns.append(
+                f"only one record has '{key}' (missing in {which}; added "
+                f"in r15) — its rows are one-sided"
+            )
+    if (isinstance(ro, dict) and isinstance(rn, dict)
+            and ("gf256_matmul" in ro) != ("gf256_matmul" in rn)):
+        which = "old" if "gf256_matmul" not in ro else "new"
+        warns.append(
+            f"only one record has an rlnc 'gf256_matmul' micro-bench "
+            f"(missing in {which}; added in r15) — its rows are one-sided"
+        )
+    po = set(old.get("phase_breakdown_ms") or {})
+    pn = set(new.get("phase_breakdown_ms") or {})
+    if po and pn and po != pn:
+        warns.append(
+            f"phase breakdown keys present on only one side: "
+            f"{', '.join(sorted(po ^ pn))} — those rows are one-sided "
+            f"(hb_prologue_* added in r15)"
+        )
     # Scenario-canon inventory section (r13+): same treatment, plus a
     # loud word when an attack kind covered by the old canon vanished.
     co, cn = old.get("scenario_canon"), new.get("scenario_canon")
