@@ -91,6 +91,20 @@ RLNC_SCALE = dict(n_peers=1024, n_slots=16, degree=8, gen_size=8,
                   degraded_delay=2)
 RLNC_RUN_TIMEOUT_S = 900.0
 
+# Adaptive coded gossip crossover (BENCH_MODE=hybrid): the per-edge
+# eager<->RLNC switcher vs an eager-forced twin (same HybridGossipSub class
+# with switch thresholds above 1.0, so the loss EWMA — a probability — can
+# never flip an edge) on the IDENTICAL fixed-seed topology, swept over
+# uniform ingress-decimation delays.  loss_frac = d / (d + 1); the reported
+# crossover is the smallest swept loss rate where the adaptive plane
+# strictly beats eager (higher delivery, or equal delivery at lower p99
+# rounds).  At d=0 the two are bit-identical by construction (the identity
+# guard in tests/test_hybrid.py), so the row reads as a true tie.
+HYBRID_SCALE = dict(n_peers=256, n_slots=16, degree=8, gen_size=4,
+                    msg_window=32, heartbeat_steps=4, steps=32,
+                    topo_seed=0, delays=(0, 1, 2, 3))
+HYBRID_RUN_TIMEOUT_S = 900.0
+
 # Streaming serving plane (BENCH_MODE=streaming): ONE resident multitopic
 # rollout (serve.engine) fed an open publish stream through the ingest ring
 # (serve.ingest), with the signed window verified INLINE ahead of enqueue —
@@ -248,6 +262,29 @@ def _run_rlnc_child(probe_ok: bool) -> dict:
     return {"error": " | ".join(a[:300] for a in attempts)}
 
 
+def _run_hybrid_child(probe_ok: bool) -> dict:
+    """Run the BENCH_MODE=hybrid child (adaptive coded gossip crossover
+    sweep).  Accelerator first when the probe passed, CPU fallback
+    otherwise; failure becomes an ``error`` dict, never a crash."""
+    attempts = []
+    if probe_ok:
+        parsed, tail = run_child(
+            {"BENCH_MODE": "hybrid"}, HYBRID_RUN_TIMEOUT_S
+        )
+        if parsed is not None:
+            return parsed
+        attempts.append(f"accelerator attempt: {tail}")
+        log("orchestrator: hybrid accelerator child failed; retrying on CPU")
+    parsed, tail = run_child(
+        {"BENCH_MODE": "hybrid", "JAX_PLATFORMS": "cpu"},
+        HYBRID_RUN_TIMEOUT_S,
+    )
+    if parsed is not None:
+        return parsed
+    attempts.append(f"cpu attempt: {tail}")
+    return {"error": " | ".join(a[:300] for a in attempts)}
+
+
 def _run_streaming_child(probe_ok: bool) -> dict:
     """Run the BENCH_MODE=streaming child (resident rollout + ingest ring
     under sustained load).  Accelerator first when the probe passed, CPU
@@ -321,6 +358,12 @@ def orchestrate() -> None:
     if os.environ.get("BENCH_RLNC", "1") != "0":
         log("orchestrator: running rlnc child (BENCH_MODE=rlnc)")
         record["rlnc"] = _run_rlnc_child(probe_ok)
+
+    # Adaptive coded gossip crossover rides along the same way
+    # (tools/perf_diff.py diffs it; BENCH_HYBRID=0 skips it).
+    if os.environ.get("BENCH_HYBRID", "1") != "0":
+        log("orchestrator: running hybrid child (BENCH_MODE=hybrid)")
+        record["hybrid"] = _run_hybrid_child(probe_ok)
 
     # Streaming serving plane rides along the same way
     # (tools/perf_diff.py diffs it; BENCH_STREAMING=0 skips it).
@@ -1014,6 +1057,163 @@ def rlnc_child_main() -> None:
     )
 
 
+def hybrid_child_main() -> None:
+    """BENCH_MODE=hybrid: adaptive coded gossip crossover sweep (ISSUE 12
+    tentpole).  For each uniform ingress-decimation delay d (loss rate
+    d/(d+1)) run the SAME fixed-seed topology twice — adaptive per-edge
+    switcher vs the eager-forced twin — and report delivery/p50/p99 per
+    mode plus the measured crossover loss rate.  Closed loop (rollout
+    rounds, not wall seconds) so the comparison is deterministic and
+    backend-honest.  Emits one JSON line the orchestrator nests under
+    ``hybrid``."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from go_libp2p_pubsub_tpu.models.hybrid import HybridGossipSub
+
+    cfg = HYBRID_SCALE
+    n_peers = int(os.environ.get("BENCH_HYBRID_PEERS", cfg["n_peers"]))
+    steps = int(os.environ.get("BENCH_HYBRID_STEPS", cfg["steps"]))
+    dev = jax.devices()[0]
+    backend = dev.device_kind
+    log(f"hybrid bench: {backend}  n_peers={n_peers}  steps={steps}  "
+        f"gen_size={cfg['gen_size']}")
+    rng = np.random.default_rng(3)
+    srcs = rng.integers(n_peers, size=cfg["msg_window"])
+
+    common = dict(n_peers=n_peers, n_slots=cfg["n_slots"],
+                  conn_degree=cfg["degree"], msg_window=cfg["msg_window"],
+                  heartbeat_steps=cfg["heartbeat_steps"],
+                  gen_size=cfg["gen_size"])
+    adaptive = HybridGossipSub(**common)
+    # Thresholds above 1.0: loss_ewma is a probability, so no edge ever
+    # switches — pure eager+IWANT through the identical machinery.
+    eager = HybridGossipSub(**common, switch_hi=2.0, switch_lo=1.5)
+
+    def measure(model, name, delay):
+        st = model.init(seed=cfg["topo_seed"])
+        st = model.set_ingress_loss(st, delay)
+        for slot in range(cfg["msg_window"]):
+            st = model.publish(
+                st, jnp.int32(int(srcs[slot])), jnp.int32(slot),
+                jnp.asarray(True),
+            )
+        t0 = time.perf_counter()
+        jax.block_until_ready(model.rollout(st, steps, record=True))
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out, rec = model.rollout(st, steps, record=True)
+        jax.block_until_ready((out, rec))
+        rollout_dt = time.perf_counter() - t0
+        frac, p50, p99 = (np.asarray(x) for x in model.delivery_stats(out))
+        mean_frac = float(np.nanmean(frac))
+        coded_edges = int(np.asarray(rec["coded_edges"])[-1])
+        log(f"{name}/d={delay}: frac {mean_frac:.4f}  "
+            f"p50 {float(np.nanmean(p50)):.0f} "
+            f"p99 {float(np.nanmean(p99)):.0f} rounds  "
+            f"coded_edges {coded_edges}  "
+            f"(rollout {rollout_dt:.2f}s, compile {compile_s:.1f}s)")
+        return {
+            "delivery_frac": round(mean_frac, 6),
+            "p50_latency_rounds": float(np.nanmean(p50)),
+            "p99_latency_rounds": float(np.nanmean(p99)),
+            "coded_edges_final": coded_edges,
+            "rollout_s": round(rollout_dt, 3),
+            "compile_s": round(compile_s, 1),
+        }
+
+    rows = []
+    crossover = None
+    for delay in cfg["delays"]:
+        loss_frac = delay / (delay + 1)
+        a = measure(adaptive, "adaptive", delay)
+        e = measure(eager, "eager_forced", delay)
+        # Strict win: more delivered, or equal delivery at a lower p99.
+        wins = (
+            a["delivery_frac"] > e["delivery_frac"] + 1e-9
+            or (
+                abs(a["delivery_frac"] - e["delivery_frac"]) <= 1e-9
+                and a["p99_latency_rounds"] < e["p99_latency_rounds"]
+            )
+        )
+        rows.append({
+            "delay": delay,
+            "loss_frac": round(loss_frac, 4),
+            "adaptive": a,
+            "eager_forced": e,
+            "adaptive_wins": bool(wins),
+        })
+        if wins and crossover is None:
+            crossover = round(loss_frac, 4)
+
+    log(f"crossover loss_frac: {crossover}")
+
+    # Coded-serving recovery channels: run the two r16 canons through the
+    # real streaming runner so the bench record carries the crash-recovery
+    # and eager-comparison measurements tools/perf_diff.py diffs.
+    from go_libp2p_pubsub_tpu.scenario import canon as canon_mod
+    from go_libp2p_pubsub_tpu.scenario.streaming_runner import (
+        run_streaming_scenario,
+    )
+
+    try:
+        deg = run_streaming_scenario(
+            canon_mod.CANON["streaming_degraded_links"]()
+        )
+        crash = run_streaming_scenario(
+            canon_mod.CANON["streaming_rlnc_crash_recovery"]()
+        )
+        coded_serving = {
+            "degraded_passed": bool(deg.verdict.passed),
+            "p99_vs_eager_ratio": float(
+                deg.record["p99_vs_eager_ratio"][-1]
+            ),
+            "crash_passed": bool(crash.verdict.passed),
+            "recovery_s": round(float(crash.record["recovery_s"][-1]), 4),
+            "lost_after_restart": int(
+                crash.record["lost_after_restart"][-1]
+            ),
+            "duplicate_deliveries": int(
+                crash.record["duplicate_deliveries"][-1]
+            ),
+            "compile_cache_size": int(
+                crash.engine_stats["compile_cache_size"]
+            ),
+        }
+        log(f"coded serving canons: {coded_serving}")
+    except Exception as e:  # canon failure is a record, not a crash
+        coded_serving = {"error": str(e)[:300]}
+        log(f"coded serving canons FAILED: {e}")
+
+    print(
+        json.dumps(
+            {
+                "metric": "hybrid_crossover_loss_frac",
+                "value": crossover if crossover is not None else -1.0,
+                "unit": "loss_frac",
+                "methodology_version": 1,
+                "n_peers": n_peers,
+                "gen_size": cfg["gen_size"],
+                "rollout_steps": steps,
+                "backend": backend,
+                "topo_seed": cfg["topo_seed"],
+                "loss_semantics": (
+                    "uniform per-receiver ingress decimation: "
+                    "accept iff step % (d+1) == 0; loss_frac = d/(d+1)"
+                ),
+                "sweep": rows,
+                "by_delay": {f"d{r['delay']}": r for r in rows},
+                "coded_serving": coded_serving,
+            }
+        ),
+        flush=True,
+    )
+
+
 def streaming_child_main() -> None:
     """BENCH_MODE=streaming: sustained-load serving bench (ISSUE 7
     tentpole).  One resident multitopic engine, compiled once, fed three
@@ -1343,6 +1543,8 @@ def child_main() -> None:
         return sharded_child_main()
     if mode == "rlnc":
         return rlnc_child_main()
+    if mode == "hybrid":
+        return hybrid_child_main()
     if mode == "streaming":
         return streaming_child_main()
     scale = TPU_SCALE if mode == "tpu" else CPU_SCALE

@@ -1013,7 +1013,13 @@ class GossipSub:
             key=knext,
         )
 
-    def _propagate(self, st: GossipState, with_receipts: bool = False):
+    def _propagate(
+        self,
+        st: GossipState,
+        with_receipts: bool = False,
+        eager_edge_ok: Optional[jax.Array] = None,
+        ingress_ok: Optional[jax.Array] = None,
+    ):
         # Fold due gossip/flood deliveries (granted or offered last round)
         # into this round's receipts.  These copies arrive this round and
         # relay NEXT round (they join fresh_w after the eager push below) —
@@ -1022,12 +1028,24 @@ class GossipSub:
         # measured hop latency.  A peer with ingress latency (gossip_delay)
         # holds its pending transfers for that many extra rounds before they
         # fold; bits arriving mid-hold join the held batch.
+        #
+        # Hybrid hooks (models/hybrid.py): ``eager_edge_ok`` bool[N, K]
+        # additionally gates which edges eager-push (coded edges suppress
+        # eager), ``ingress_ok`` bool[N] is a per-receiver loss gate — a
+        # round where it is False drops the peer's ENTIRE data-plane ingress
+        # (eager pushes AND the pend fold; dropped pend bits leave the plane
+        # and must be re-requested at a later heartbeat).  Control traffic
+        # (IHAVE/IWANT) is not subject to the gate.  Both default to None,
+        # which leaves this method's graph byte-identical to the pre-hybrid
+        # form.
         ready = st.pend_hold <= 0
         ready_w = gossip_ops._as_mask(ready)[:, None]
         gossip_new = (
             st.gossip_pend_w & ready_w & ~st.have_w
             & gossip_ops._as_mask(st.alive)[:, None]
         )
+        if ingress_ok is not None:
+            gossip_new = gossip_new & gossip_ops._as_mask(ingress_ok)[:, None]
         held_w = st.gossip_pend_w & ~ready_w
         have_w = st.have_w | gossip_new
 
@@ -1046,6 +1064,8 @@ class GossipSub:
             relay_mesh = relay_mesh | (
                 self.direct_edges & st.subscribed[:, None]
             )
+        if eager_edge_ok is not None:
+            relay_mesh = relay_mesh & eager_edge_ok
         valid_w = bitpack.pack(st.msg_valid & st.msg_active)
         # Per-edge delay mode: each edge reads its sender's fresh plane from
         # edge_delay[i, s] rounds back (plane (step-1-d) mod D of the rolling
@@ -1105,6 +1125,23 @@ class GossipSub:
                 st.fresh_w, valid_w, fresh_src=fresh_src,
                 idontwant=idontwant, idw_have_w=idw,
                 device_mesh=self.split_gather_mesh,
+            )
+        if ingress_ok is not None:
+            # Per-receiver loss gate: a closed receiver's eager arrivals are
+            # dropped on the floor — no possession, no fresh relay, and no
+            # score credit (the copies never crossed the wire).  ``have_w``
+            # going into the kernel already includes the (gated) pend fold,
+            # so rebuilding possession from the masked first-receipt set is
+            # exact.
+            iok_w = gossip_ops._as_mask(ingress_ok)[:, None]
+            iok_f = ingress_ok.astype(jnp.float32)[:, None]
+            out = gossip_ops.PropagatePackedOut(
+                have_w=have_w | (out.new_w & iok_w & valid_w),
+                fresh_w=out.fresh_w & iok_w,
+                new_w=out.new_w & iok_w,
+                fmd_inc=out.fmd_inc * iok_f,
+                mmd_inc=out.mmd_inc * iok_f,
+                invalid_inc=out.invalid_inc * iok_f,
             )
         # One [N, M] stamping pass for both receipt sources (pend fold +
         # eager push): both record the same step, so the union stamps once.

@@ -1,0 +1,568 @@
+"""Adaptive coded gossip: per-edge eager <-> RLNC switching in one scan.
+
+OPTIMUMP2P's framing (and our own r11 numbers) puts the two dissemination
+planes at opposite ends of the loss axis: eager+IWANT is latency-optimal on
+clean links but pays recovery round trips under loss, while RLNC coded
+fragments need no recovery protocol at all — every accepted round adds an
+independent equation, so sustained loss only stretches decode time instead
+of triggering retransmission.  Real meshes are mixed (the Filecoin/ETH2
+evaluation), so the right protocol is per-EDGE, not per-network.
+
+:class:`HybridGossipSub` embeds a full single-topic :class:`GossipSub` and
+adds a coded plane over the same topology:
+
+- ``ops/loss_estimator.py`` maintains a per-edge loss EWMA from
+  expected-vs-observed receipts, with hysteresis so edges don't flap;
+- clean edges run the unmodified eager+IHAVE/IWANT machinery; edges whose
+  estimate crosses ``switch_hi`` suppress eager and carry GF(256) RLNC
+  fragments instead (generation = window slot, ``gen_size`` fragments,
+  structured pivot-slot bases folded by ``gf256.rref_insert``);
+- a decode completing (rank hits ``gen_size``) merges back into the gossip
+  plane as a first receipt: possession bit, ``first_step`` stamp, and a
+  fresh bit so the decoded message eager-relays onward over clean edges.
+
+The switch is a masked merge inside the SAME ``lax.scan`` rollout — the
+coded fold is ``lax.cond``-gated on any edge being coded, so an all-clean
+fabric pays one predicate per round, and the whole hybrid state (including
+every decode basis) rides one scan carry.  With loss estimation forced to
+all-clean the rollout is leaf-for-leaf bit-identical to plain eager
+GossipSub, flight-recorder channels included (asserted in
+``tests/test_hybrid.py``) — the masks degenerate to value-level no-ops and
+the coded plane's PRNG stream is separate from the gossip key chain.
+
+Loss model: per-receiver ingress DECIMATION, the RLNC family's convention
+(r11) — a peer with ``ingress_loss[i] = d`` accepts data-plane traffic
+only on rounds where ``step % (d + 1) == 0``; off-round eager pushes and
+pend-fold transfers are LOST (not held), off-round fragments are lost too.
+The asymmetry against the mesh families' lossless ``gossip_delay`` hold is
+deliberate: this model answers "what if the link actually drops frames",
+which is the regime where coding pays.
+
+Serving plane: the model speaks the streaming engine's dialect —
+``MultiTopicEvents`` schedules with ``t = 1`` (``delay`` rows set
+``ingress_loss``), a ``stream_digest`` in [T=1, M] shape, and value
+semantics for the resident-rollout cache — so ``serve/engine.py`` threads
+RLNC generations through its chunks unchanged, and its checkpoint payload
+(the full model state) carries every per-(peer, generation) decode basis:
+a crash mid-generation restores partial rank and finishes the decode
+exactly-once (``tests/test_crash_safety.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import bitpack
+from ..ops import gf256
+from ..ops import histogram as hist_ops
+from ..ops import loss_estimator as loss_ops
+from .gossipsub import (
+    FLIGHT_HIST_BINS,
+    GossipState,
+    GossipSub,
+    compute_edge_live,
+)
+
+
+class HybridState(NamedTuple):
+    """Full hybrid carry: the embedded gossip state plus the coded plane.
+
+    ``gossip`` is a complete :class:`GossipState`; the extra leaves are
+    hybrid-only, so a forced-clean rollout leaves them at their init values
+    and the embedded leaves bit-identical to a plain GossipSub run.
+    """
+
+    gossip: GossipState
+    loss_ewma: jax.Array    # f32[N, K] per-edge loss estimate
+    coded: jax.Array        # bool[N, K] edges currently on the coded plane
+    basis: jax.Array        # u8[N, M, Kg, Kg] per-(peer, generation) decode
+    #                         bases in rref_insert's pivot-slot form — the
+    #                         crash-safe decode state the engine checkpoints
+    ingress_loss: jax.Array  # i32[N] decimation period (0 = lossless)
+    key_coded: jax.Array    # coded plane's PRNG (separate from gossip key)
+
+
+class HybridGossipSub:
+    """Single-topic adaptive eager/RLNC hybrid with static shapes."""
+
+    def __init__(
+        self,
+        n_peers: int = 1024,
+        n_slots: int = 32,
+        conn_degree: int = 16,
+        msg_window: int = 64,
+        heartbeat_steps: int = 8,
+        gen_size: int = 4,
+        switch_hi: float = 0.35,
+        switch_lo: float = 0.15,
+        ewma_alpha: float = 0.25,
+        params=None,
+        score_params=None,
+        builder=None,
+        peer_uid: Optional[np.ndarray] = None,
+        use_mxu: Optional[bool] = None,
+    ):
+        if not (1 <= gen_size <= 255):
+            raise ValueError(f"gen_size must be in [1, 255], got {gen_size}")
+        if not (0.0 <= switch_lo < switch_hi):
+            raise ValueError(
+                f"need 0 <= switch_lo < switch_hi, got "
+                f"lo={switch_lo} hi={switch_hi}"
+            )
+        if not (0.0 < ewma_alpha <= 1.0):
+            raise ValueError(f"ewma_alpha must be in (0, 1], got {ewma_alpha}")
+        # The embedded eager plane: the ideal fabric (max_edge_delay=0, no
+        # direct peering) — the hybrid's loss model is its own decimation
+        # gate, and fresh-history / direct-edge modes would desync from the
+        # decoded-bit merge into fresh_w.
+        self.gs = GossipSub(
+            n_peers=n_peers,
+            n_slots=n_slots,
+            conn_degree=conn_degree,
+            msg_window=msg_window,
+            params=params,
+            score_params=score_params,
+            heartbeat_steps=heartbeat_steps,
+            use_pallas=False,
+            builder=builder,
+            peer_uid=peer_uid,
+        )
+        self.gen_size = gen_size
+        self.switch_hi = float(switch_hi)
+        self.switch_lo = float(switch_lo)
+        self.ewma_alpha = float(ewma_alpha)
+        # GF(256) kernel flavor: the MXU carry-less decomposition is the TPU
+        # default (r15); the table path is bit-exact with it everywhere.
+        if use_mxu is None:
+            use_mxu = jax.default_backend() == "tpu"
+        self.use_mxu = bool(use_mxu)
+
+    # -- engine surface (MultiTopicGossipSub dialect, T = 1) ----------------
+
+    t = 1
+
+    @property
+    def n(self) -> int:
+        return self.gs.n
+
+    @property
+    def k(self) -> int:
+        return self.gs.k
+
+    @property
+    def m(self) -> int:
+        return self.gs.m
+
+    @property
+    def w(self) -> int:
+        return self.gs.w
+
+    @property
+    def heartbeat_steps(self) -> int:
+        return self.gs.heartbeat_steps
+
+    # Value semantics for the jit cache (the engine's resident-rollout
+    # contract): equal configs share compiled chunks across the crash
+    # restart.
+    def _config_key(self):
+        return (
+            type(self), self.gs._config_key(), self.gen_size,
+            self.switch_hi, self.switch_lo, self.ewma_alpha, self.use_mxu,
+        )
+
+    def __eq__(self, other):
+        return (
+            type(other) is type(self)
+            and self._config_key() == other._config_key()
+        )
+
+    def __hash__(self):
+        return hash(self._config_key())
+
+    def stream_model_key(self) -> str:
+        """Config fingerprint for streaming-engine checkpoint meta."""
+        return (
+            f"hybrid t=1 n={self.n} k={self.k} m={self.m} w={self.w} "
+            f"hb={self.heartbeat_steps} kg={self.gen_size} "
+            f"hi={self.switch_hi} lo={self.switch_lo}"
+        )
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def init(
+        self, seed: int = 0, subscribed: Optional[np.ndarray] = None
+    ) -> HybridState:
+        g = self.gs.init(seed, subscribed)
+        n, k, m, kg = self.n, self.k, self.m, self.gen_size
+        return HybridState(
+            gossip=g,
+            loss_ewma=jnp.zeros((n, k), jnp.float32),
+            coded=jnp.zeros((n, k), bool),
+            basis=jnp.zeros((n, m, kg, kg), jnp.uint8),
+            ingress_loss=jnp.zeros((n,), jnp.int32),
+            # A fold of the seed key, NOT a split of the gossip chain: the
+            # gossip key stream must be untouched for bit-identity.
+            key_coded=jax.random.fold_in(jax.random.PRNGKey(seed), 0xC0DE),
+        )
+
+    def set_ingress_loss(self, st: HybridState, delay) -> HybridState:
+        """Host-side loss knob: set every peer's decimation period (or a
+        per-peer i32[N] vector).  0 restores the lossless fabric."""
+        d = jnp.broadcast_to(
+            jnp.asarray(delay, jnp.int32), (self.n,)
+        )
+        return st._replace(ingress_loss=d)
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def publish(
+        self, st: HybridState, src, slot, valid
+    ) -> HybridState:
+        """Publish into the window slot on BOTH planes: the gossip seed
+        (window recycle + publisher stamp) plus the coded generation's
+        identity basis at the publisher.  Invalid publishes never seed a
+        generation — the coded plane only carries validated traffic (the
+        eager plane still floods them, for scoring parity)."""
+        g = self.gs.publish(st.gossip, src, slot, valid)
+        kg = self.gen_size
+        seed_rows = jnp.eye(kg, dtype=jnp.uint8) * jnp.asarray(
+            valid, jnp.uint8
+        )
+        basis = st.basis.at[:, slot].set(jnp.zeros((kg, kg), jnp.uint8))
+        basis = basis.at[src, slot].set(seed_rows)
+        return st._replace(gossip=g, basis=basis)
+
+    # -- one round ----------------------------------------------------------
+
+    def _step_core(self, st: HybridState, with_receipts: bool = False):
+        """One hybrid network round (pre-heartbeat, pre-step-increment):
+        gated eager propagate, cond-gated coded fold + decode merge, and the
+        loss-estimator update.  Returns ``(state, per_msg | None)``."""
+        g = st.gossip
+        n, k, m, kg = self.n, self.k, self.m, self.gen_size
+        # Per-receiver ingress decimation gate, the r11 RLNC convention:
+        # rounds where the gate is closed LOSE all data-plane ingress.
+        accept = jnp.mod(g.step, st.ingress_loss + 1) == 0        # bool[N]
+
+        # Loss-estimator "expected" plane, computed BEFORE the round mutates
+        # the state: while the message window carries live traffic, every
+        # eager-eligible or coded live edge is expected to deliver each
+        # round, so a closed ingress gate is a miss.  Keying on window
+        # liveness rather than the sender's instantaneous fresh set matters
+        # under real loss: dropped pushes kill the fresh chain within a
+        # round or two, and an estimator that only counts fresh-holding
+        # senders starves before it can cross the switch threshold.  The
+        # estimate converges to the edge's true frame-loss rate
+        # (d / (d + 1) under decimation) and stays at exactly 0.0 on a
+        # clean fabric.
+        j = jnp.clip(g.nbrs, 0, n - 1)
+        relay_mesh = g.mesh & (
+            g.scores >= self.gs.score_params.graylist_threshold
+        )
+        gen_live = g.msg_valid & g.msg_active & g.msg_used        # bool[M]
+        rank = gf256.gf_rank(st.basis)                            # i32[N, M]
+        send_gen = (rank > 0) & gen_live[None, :]                 # bool[N, M]
+        expected = (
+            g.edge_live & gen_live.any() & (relay_mesh | st.coded)
+        )
+
+        # Eager plane: coded edges suppressed, closed receivers drop their
+        # ingress.  Both masks are value-level no-ops on a clean fabric.
+        if with_receipts:
+            g2, per_msg = self.gs._propagate(
+                g, with_receipts=True,
+                eager_edge_ok=~st.coded, ingress_ok=accept,
+            )
+        else:
+            g2 = self.gs._propagate(
+                g, eager_edge_ok=~st.coded, ingress_ok=accept,
+            )
+            per_msg = None
+
+        # Coded plane: every coded edge's sender emits one fresh GF(256)
+        # combination per active generation per round; receivers fold
+        # accepted fragments into their pivot-slot bases and completed
+        # decodes merge back into the gossip plane as first receipts.  The
+        # key splits OUTSIDE the cond so the coded PRNG stream does not
+        # depend on which rounds had coded edges.
+        kc, kcn = jax.random.split(st.key_coded)
+
+        def coded_round(op):
+            gg, basis = op
+            coeffs = gf256.coeffs_by_uid(
+                kc, (n, k, m, kg), self.gs.peer_uid
+            )
+            combine = gf256.gf_combine_mxu if self.use_mxu else gf256.gf_combine
+            frag = combine(coeffs, basis[:, None])        # u8[N, K, M, Kg]
+            rv = jnp.clip(gg.rev, 0, k - 1)
+            incoming = frag.reshape(n * k, m, kg)[j * k + rv]
+            ok_edge = (
+                st.coded & gg.edge_live
+                & accept[:, None]
+                & (gg.alive & gg.subscribed)[:, None]
+            )
+            ok = ok_edge[:, :, None] & (send_gen & ~gg.gossip_mute[:, None])[j]
+            incoming = jnp.where(ok[..., None], incoming, jnp.uint8(0))
+            insert = jax.vmap(jax.vmap(gf256.rref_insert))
+
+            def fold(s, b):
+                return insert(b, incoming[:, s])[0]
+
+            basis = jax.lax.fori_loop(0, k, fold, basis)
+            # Decode completion = first receipt: possession + fresh (the
+            # decoded bytes eager-relay onward over clean edges) + latency
+            # stamp.  Peers already stamped by the eager plane this round
+            # (or ever) are skipped — exactly-once per (peer, message).
+            done = (
+                (gf256.gf_rank(basis) == kg)
+                & gen_live[None, :]
+                & (gg.first_step < 0)
+            )
+            done_w = bitpack.pack(done)
+            gg = gg._replace(
+                have_w=gg.have_w | done_w,
+                fresh_w=gg.fresh_w | done_w,
+                first_step=jnp.where(done, gg.step, gg.first_step),
+            )
+            per_coded = (
+                done
+                & (gg.alive & gg.subscribed)[:, None]
+            ).sum(axis=0, dtype=jnp.int32)
+            return gg, basis, per_coded
+
+        def coded_skip(op):
+            gg, basis = op
+            return gg, basis, jnp.zeros((m,), jnp.int32)
+
+        g3, basis2, per_coded = jax.lax.cond(
+            st.coded.any(), coded_round, coded_skip, (g2, st.basis)
+        )
+        if per_msg is not None:
+            per_msg = per_msg + per_coded
+
+        est = loss_ops.update(
+            loss_ops.LossEstimate(st.loss_ewma, st.coded),
+            expected, accept[:, None],
+            self.ewma_alpha, self.switch_hi, self.switch_lo,
+        )
+        nxt = st._replace(
+            gossip=g3,
+            loss_ewma=est.loss_ewma,
+            coded=est.coded,
+            basis=basis2,
+            key_coded=kcn,
+        )
+        return nxt, per_msg
+
+    def _finish_round(self, st: HybridState) -> HybridState:
+        """Heartbeat cond + step increment, matching ``GossipSub.step``'s
+        ordering on the embedded state."""
+        g = jax.lax.cond(
+            (st.gossip.step % self.heartbeat_steps)
+            == self.heartbeat_steps - 1,
+            self.gs._heartbeat,
+            lambda s: s,
+            st.gossip,
+        )
+        return st._replace(gossip=g._replace(step=g.step + 1))
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def step(self, st: HybridState) -> HybridState:
+        st, _ = self._step_core(st)
+        return self._finish_round(st)
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def step_recorded(self, st: HybridState):
+        """``step`` plus the receipt tap (eager stampings + coded decode
+        completions this round) — same state graph as ``step``."""
+        st, per_msg = self._step_core(st, with_receipts=True)
+        return self._finish_round(st), per_msg
+
+    # -- rollouts -----------------------------------------------------------
+
+    @functools.partial(jax.jit, static_argnames=("self", "n_steps", "record"))
+    def rollout(self, st: HybridState, n_steps: int, record: bool = True):
+        """``n_steps`` rounds in one scan -> (final state, record | None);
+        the recorder architecture (carried cumulative latency histogram,
+        per-round channel dict) mirrors ``GossipSub.rollout``."""
+        if not record:
+            def bare(s, _):
+                return self.step(s), None
+
+            return jax.lax.scan(bare, st, None, length=n_steps)
+
+        g0 = st.gossip
+        hist0 = hist_ops.latency_histogram_seed(
+            g0.first_step, g0.msg_birth, g0.msg_used & g0.msg_valid,
+            g0.alive & g0.subscribed, FLIGHT_HIST_BINS,
+        )
+
+        def body(carry, _):
+            s, hist = carry
+            s2, per_msg = self.step_recorded(s)
+            hist = hist + hist_ops.latency_histogram_increment(
+                per_msg, s2.gossip.msg_birth,
+                s2.gossip.msg_used & s2.gossip.msg_valid,
+                s.gossip.step, FLIGHT_HIST_BINS,
+            )
+            return (s2, hist), self.flight_record_round(s2, hist)
+
+        (final, _), ys = jax.lax.scan(body, (st, hist0), None, length=n_steps)
+        return final, ys
+
+    @functools.partial(jax.jit, static_argnames=("self", "record"))
+    def rollout_events(self, st: HybridState, events, record: bool = True):
+        """Run a ``MultiTopicEvents`` schedule (the streaming engine's chunk
+        dialect, T = 1) in one scan -> (final state, record | None).
+
+        Event mapping: ``kill`` / ``mute_*`` hit the embedded gossip state;
+        ``delay`` rows set ``ingress_loss`` (DECIMATION — the hybrid's loss
+        model, NOT the multitopic pend-hold; same schedule field, per-family
+        semantics, the r11 asymmetry); publishes seed both planes
+        (``pub_topic`` is clipped into the single topic).
+        """
+        n_steps = int(events.kill.shape[0])
+
+        def apply_events(s, ev):
+            g = s.gossip
+            g = jax.lax.cond(
+                ev.kill.any(),
+                lambda x: x._replace(
+                    alive=x.alive & ~ev.kill,
+                    edge_live=compute_edge_live(
+                        x.nbr_valid, x.nbrs, x.alive & ~ev.kill
+                    ),
+                ),
+                lambda x: x,
+                g,
+            )
+            g = jax.lax.cond(
+                ev.mute_on.any() | ev.mute_off.any(),
+                lambda x: x._replace(
+                    gossip_mute=(x.gossip_mute & ~ev.mute_off) | ev.mute_on
+                ),
+                lambda x: x,
+                g,
+            )
+            s = s._replace(gossip=g)
+            s = jax.lax.cond(
+                (ev.delay >= 0).any(),
+                lambda x: x._replace(
+                    ingress_loss=jnp.where(
+                        ev.delay >= 0, ev.delay, x.ingress_loss
+                    )
+                ),
+                lambda x: x,
+                s,
+            )
+            for i in range(ev.pub_src.shape[0]):
+                s = jax.lax.cond(
+                    (ev.pub_src[i] >= 0) & (ev.pub_topic[i] >= 0),
+                    lambda x, jx=i: self.publish(
+                        x,
+                        ev.pub_src[jx],
+                        jnp.clip(ev.pub_slot[jx], 0, self.m - 1),
+                        ev.pub_valid[jx],
+                    ),
+                    lambda x: x,
+                    s,
+                )
+            return s
+
+        if not record:
+            def bare(s, ev):
+                s = apply_events(s, ev)
+                s, _ = self._step_core(s)
+                return self._finish_round(s), None
+
+            return jax.lax.scan(bare, st, events, length=n_steps)
+
+        g0 = st.gossip
+        hist0 = hist_ops.latency_histogram_seed(
+            g0.first_step, g0.msg_birth, g0.msg_used & g0.msg_valid,
+            g0.alive & g0.subscribed, FLIGHT_HIST_BINS,
+        )
+
+        def body(carry, ev):
+            s, hist = carry
+            s = apply_events(s, ev)
+            # Publisher self-receipts land in the histogram at bin 0 (the
+            # GossipSub.rollout_events convention).
+            src_c = jnp.clip(ev.pub_src, 0, self.n - 1)
+            pub_counted = (
+                (ev.pub_src >= 0)
+                & (ev.pub_topic >= 0)
+                & ev.pub_valid
+                & s.gossip.alive[src_c]
+                & s.gossip.subscribed[src_c]
+            ).sum(dtype=jnp.int32)
+            hist = hist.at[0].add(pub_counted)
+            s2, per_msg = self._step_core(s, with_receipts=True)
+            hist = hist + hist_ops.latency_histogram_increment(
+                per_msg, s2.gossip.msg_birth,
+                s2.gossip.msg_used & s2.gossip.msg_valid,
+                s.gossip.step, FLIGHT_HIST_BINS,
+            )
+            s2 = self._finish_round(s2)
+            return (s2, hist), self.flight_record_round(s2, hist)
+
+        (final, _), ys = jax.lax.scan(body, (st, hist0), events, length=n_steps)
+        return final, ys
+
+    # -- flight recorder / views --------------------------------------------
+
+    def flight_record_round(self, st: HybridState, lat_hist: jax.Array):
+        """The embedded GossipSub channels (bit-identical on a clean
+        fabric) plus the hybrid's own: how many edges are coded, and the
+        mean per-edge loss estimate over wired slots."""
+        rec = self.gs.flight_record_round(st.gossip, lat_hist)
+        wired = st.gossip.nbr_valid
+        rec["coded_edges"] = (st.coded & wired).sum().astype(jnp.int32)
+        rec["loss_ewma_mean"] = (
+            jnp.where(wired, st.loss_ewma, 0.0).sum()
+            / jnp.maximum(wired.sum(), 1)
+        )
+        return rec
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def delivery_stats(self, st: HybridState):
+        return self.gs.delivery_stats(st.gossip)
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def stream_digest(self, st: HybridState):
+        """Per-slot completion counters in the engine's [T=1, ...] shapes.
+
+        Counted from ``first_step`` (the immutable receipt record, which
+        the coded merge stamps too) rather than possession words, so a
+        seen-cache TTL scrub never un-counts a delivery mid-stream.
+        """
+        g = st.gossip
+        part = g.alive & g.subscribed
+        delivered = ((g.first_step >= 0) & part[:, None]).sum(
+            axis=0, dtype=jnp.int32
+        )
+        return {
+            "delivered": delivered[None, :],
+            "participants": part.sum(dtype=jnp.int32)[None],
+            "msg_used": g.msg_used[None, :],
+            "msg_valid": g.msg_valid[None, :],
+            "msg_birth": g.msg_birth[None, :],
+            "step": g.step,
+        }
+
+    def decode_rank_summary(self, st: HybridState) -> dict:
+        """Host-side decode-progress counts for checkpoint meta: how many
+        (peer, generation) bases are mid-decode vs fully decoded over live
+        generations."""
+        g = st.gossip
+        rank = np.asarray(jax.device_get(gf256.gf_rank(st.basis)))
+        live = np.asarray(
+            jax.device_get(g.msg_used & g.msg_valid & g.msg_active)
+        )[None, :]
+        partial = int(((rank > 0) & (rank < self.gen_size) & live).sum())
+        full = int(((rank == self.gen_size) & live).sum())
+        return {"partial": partial, "full": full}

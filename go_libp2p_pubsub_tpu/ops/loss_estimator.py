@@ -1,0 +1,86 @@
+"""Online per-edge loss estimation for the adaptive coded-gossip hybrid.
+
+The hybrid model (``models/hybrid.py``) needs a device-resident answer to
+"is this edge lossy enough that coding beats eager retransmission?" —
+computed INSIDE the rollout scan, from signals the round already produces,
+with no host involvement.  The estimator is deliberately protocol-shaped
+rather than oracle-shaped: a receiver can observe that a neighbor *should*
+have delivered this round (the edge was eager-eligible and the sender held
+fresh traffic — exactly what the flight recorder's receipt/backlog
+channels aggregate globally) and whether its own ingress actually accepted
+anything, so the per-edge estimate is an EWMA over expected-vs-observed
+receipts:
+
+    loss'[i, s] = (1 - alpha) * loss[i, s] + alpha * miss[i, s]
+
+updated only on rounds where ``expected[i, s]`` is True (edges with no
+traffic keep their estimate — silence is not evidence of loss).
+
+Mode selection applies hysteresis so edges don't flap between planes at
+the threshold: an edge switches to coded when its estimate rises above
+``hi`` and back to eager only after it falls below ``lo < hi``.  Between
+the thresholds the previous mode sticks.
+
+Everything here is elementwise [N, K] math — no gathers, no RNG.  Identity
+discipline: the estimate is indexed by (receiver row, neighbor slot), the
+same frame as every other per-edge plane (``scores``, ``edge_live``), so a
+placement-relabeled run (``peer_uid``) needs no extra plumbing — the slot
+pairing itself is already canonical.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class LossEstimate(NamedTuple):
+    loss_ewma: jnp.ndarray  # f32[N, K] per-edge loss estimate in [0, 1]
+    coded: jnp.ndarray      # bool[N, K] edges currently on the coded plane
+
+
+def ewma_update(
+    loss_ewma: jnp.ndarray,  # f32[N, K]
+    expected: jnp.ndarray,   # bool[N, K] sender had deliverable traffic
+    observed: jnp.ndarray,   # bool[N, K] receiver ingress accepted this round
+    alpha: float,
+) -> jnp.ndarray:
+    """One round's EWMA fold: edges with expected traffic move toward their
+    miss indicator; quiet edges hold their estimate."""
+    miss = (expected & ~observed).astype(jnp.float32)
+    blended = (1.0 - alpha) * loss_ewma + alpha * miss
+    return jnp.where(expected, blended, loss_ewma)
+
+
+def hysteresis_switch(
+    loss_ewma: jnp.ndarray,  # f32[N, K]
+    coded: jnp.ndarray,      # bool[N, K] current mode
+    hi: float,
+    lo: float,
+) -> jnp.ndarray:
+    """Two-threshold mode latch: above ``hi`` -> coded, below ``lo`` ->
+    eager, in between -> keep the previous mode."""
+    return jnp.where(
+        loss_ewma > hi, True, jnp.where(loss_ewma < lo, False, coded)
+    )
+
+
+def update(
+    est: LossEstimate,
+    expected: jnp.ndarray,
+    observed: jnp.ndarray,
+    alpha: float,
+    hi: float,
+    lo: float,
+) -> LossEstimate:
+    """EWMA fold + hysteresis latch, the hybrid step's one-call form.
+
+    On an all-clean fabric (``observed`` always True wherever ``expected``
+    is) the estimate is a fixed point at 0.0 and ``coded`` stays all-False
+    — the bit-identity guard the hybrid's eager twin relies on.
+    """
+    loss = ewma_update(est.loss_ewma, expected, observed, alpha)
+    return LossEstimate(
+        loss_ewma=loss, coded=hysteresis_switch(loss, est.coded, hi, lo)
+    )

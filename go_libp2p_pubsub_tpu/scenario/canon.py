@@ -659,6 +659,93 @@ def streaming_verifier_crash() -> ScenarioSpec:
     )
 
 
+# Shared hybrid-plane model dict (r16): single-topic adaptive coded mesh.
+# Small mesh (CPU-honest canon runtimes) but a real generation size, so the
+# crash canon restores genuinely partial decode ranks.  Same value-semantics
+# sharing trick as _STREAM_MESH: both hybrid canons reuse one compiled chunk.
+_HYBRID_MESH = dict(n_peers=32, n_slots=8, conn_degree=6,
+                    msg_window=16, heartbeat_steps=4, gen_size=4,
+                    switch_hi=0.35, switch_lo=0.15)
+
+
+def streaming_degraded_links() -> ScenarioSpec:
+    """STREAMING-ONLY (hybrid plane): a sustained degraded-link window —
+    per-receiver ingress decimation delay=2 (2/3 of data-plane receipts
+    lost) across the first three chunks — while a constant stream ingests.
+    The per-edge loss estimator must cross ``switch_hi`` and flip lossy
+    edges to RLNC coded fragments; the comparative SLO is the point: the
+    adaptive plane's p99 ingest→delivery must beat an eager-forced twin
+    replaying the identical timeline (ratio < 1, or 0.0 when eager never
+    finishes at all).  The window ends before the drain so the twin gets
+    clean fabric to catch up on — the ratio measures the coding gain, not
+    an eager blackout."""
+    return ScenarioSpec(
+        name="streaming_degraded_links",
+        family="hybrid",
+        n_steps=32,
+        seed=107,
+        model=dict(_HYBRID_MESH),
+        workloads=[
+            Workload(kind="constant", topic=0, start=0, stop=24, every=2),
+        ],
+        streaming={
+            "streaming_only": True,
+            "chunk_steps": 8,
+            "capacity": 16,
+            "policy": "block",
+            "loss": {"start_chunk": 0, "stop_chunk": 3, "delay": 2},
+            "compare_eager": True,
+        },
+        slo=SLO(
+            min_delivery_frac=0.97,
+            max_queue_depth=16,
+            max_silent_drops=0,
+            max_p99_vs_eager_ratio=0.99,
+        ),
+        description="Three lossy chunks (delay=2); adaptive coded plane "
+                    "must beat the eager-forced twin's p99.",
+    )
+
+
+def streaming_rlnc_crash_recovery() -> ScenarioSpec:
+    """STREAMING-ONLY chaos (hybrid plane): the engine is killed after its
+    second chunk while edges are coded and generations sit at PARTIAL rank
+    — the checkpoint carries per-(peer, generation) decode basis state, so
+    the restored engine resumes mid-decode instead of re-collecting
+    fragments from rank 0.  The r14 crash contract still holds leaf-for-
+    leaf: bounded recovery, zero accepted messages lost, zero duplicate
+    deliveries, one compiled chunk across the kill."""
+    return ScenarioSpec(
+        name="streaming_rlnc_crash_recovery",
+        family="hybrid",
+        n_steps=32,
+        seed=109,
+        model=dict(_HYBRID_MESH),
+        workloads=[
+            Workload(kind="constant", topic=0, start=0, stop=24, every=2),
+        ],
+        streaming={
+            "streaming_only": True,
+            "chunk_steps": 8,
+            "capacity": 16,
+            "policy": "block",
+            "snapshot_every": 1,
+            "crash_at_chunk": 2,
+            "loss": {"start_chunk": 0, "stop_chunk": 3, "delay": 2},
+        },
+        slo=SLO(
+            min_delivery_frac=0.97,
+            max_queue_depth=16,
+            max_silent_drops=0,
+            max_recovery_s=60.0,         # generous: CPU restore + replay
+            max_lost_after_restart=0,
+            max_duplicate_deliveries=0,
+        ),
+        description="Engine killed mid-generation under loss; restored "
+                    "decode basis finishes delivery exactly-once.",
+    )
+
+
 CANON: Dict[str, Callable[[], ScenarioSpec]] = {
     "steady_state": steady_state,
     "flash_crowd": flash_crowd,
@@ -683,6 +770,8 @@ CANON: Dict[str, Callable[[], ScenarioSpec]] = {
     "streaming_burst_overload": streaming_burst_overload,
     "streaming_engine_crash_recovery": streaming_engine_crash_recovery,
     "streaming_verifier_crash": streaming_verifier_crash,
+    "streaming_degraded_links": streaming_degraded_links,
+    "streaming_rlnc_crash_recovery": streaming_rlnc_crash_recovery,
 }
 
 

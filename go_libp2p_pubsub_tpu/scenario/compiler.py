@@ -93,6 +93,12 @@ def build_model(spec: ScenarioSpec, graft_spammers=None):
         if graft_spammers is not None:
             raise ValueError("graft_spam waves are gossipsub-only")
         return RLNC(**dict(spec.model))
+    if spec.family == "hybrid":
+        from ..models.hybrid import HybridGossipSub
+
+        if graft_spammers is not None:
+            raise ValueError("graft_spam waves are gossipsub-only")
+        return HybridGossipSub(**_split_model_kwargs(spec))
     # treecast: model kwargs split into SimParams / TreeOpts fields.
     from ..models.treecast import TreeCast
 
@@ -161,6 +167,14 @@ def _window(start: int, stop: Optional[int], n_steps: int) -> Tuple[int, int]:
 
 def compile_scenario(spec: ScenarioSpec) -> CompiledScenario:
     """Lower ``spec`` -> (model, initialized state, event tensors)."""
+    if spec.family == "hybrid":
+        # The hybrid's closed-sim surface speaks the streaming engine's
+        # chunk dialect (MultiTopicEvents, T = 1); its campaigns run
+        # through compile_streaming_plan / streaming_runner instead.
+        raise ValueError(
+            "hybrid family is streaming-only (set "
+            'streaming={"streaming_only": True, ...})'
+        )
     if spec.family == "treecast":
         return _compile_tree(spec)
     return _compile_gossip_like(spec)
@@ -695,21 +709,25 @@ class StreamingPlan:
     # inject at chunk boundaries, and the engine's snapshot period.
     faults: Dict[str, Any] = dataclasses.field(default_factory=dict)
     snapshot_every: int = 0
+    # r16: hybrid plane — run an eager-forced twin over the same timeline
+    # and report the p99 ingest->delivery ratio as a channel.
+    compare_eager: bool = False
 
 
 def compile_streaming_plan(spec: ScenarioSpec) -> StreamingPlan:
     """Lower ``spec`` for the streaming plane.
 
-    Honest support matrix: only the ``multitopic`` family has a resident
-    engine, and the serving plane lowers WORKLOADS only — churn, attack and
-    link windows mutate device event tensors mid-scan, which the fixed-shape
-    resident chunk deliberately does not carry (publishes are the only
-    per-chunk variable).  Requesting them raises rather than silently
-    ignoring campaign components.
+    Honest support matrix: only the ``multitopic`` and ``hybrid`` families
+    have a resident engine, and the serving plane lowers WORKLOADS only —
+    churn, attack and link windows mutate device event tensors mid-scan,
+    which the fixed-shape resident chunk deliberately does not carry
+    (publishes and, on the hybrid plane, the per-chunk ingress-loss stamp
+    are the only per-chunk variables).  Requesting them raises rather than
+    silently ignoring campaign components.
     """
-    if spec.family != "multitopic":
+    if spec.family not in ("multitopic", "hybrid"):
         raise ValueError(
-            f"streaming plane requires the multitopic family, "
+            f"streaming plane requires the multitopic or hybrid family, "
             f"got {spec.family!r}"
         )
     if spec.churn or spec.attacks or spec.links or spec.faults:
@@ -719,7 +737,11 @@ def compile_streaming_plan(spec: ScenarioSpec) -> StreamingPlan:
         )
     T = spec.n_steps
     n = int(spec.model.get("n_peers", 1024))
-    n_topics = int(spec.model.get("n_topics", 4))
+    # The hybrid is a single-topic plane (T = 1): workload topics clip to 0.
+    n_topics = (
+        1 if spec.family == "hybrid"
+        else int(spec.model.get("n_topics", 4))
+    )
     cfg = dict(spec.streaming or {})
     chunk_steps = int(cfg.get("chunk_steps", 8))
     capacity = int(cfg.get("capacity", 64))
@@ -728,6 +750,12 @@ def compile_streaming_plan(spec: ScenarioSpec) -> StreamingPlan:
     pub_width = int(cfg.get("pub_width", max(1, -(-capacity // chunk_steps))))
     completion_frac = float(cfg.get("completion_frac", 0.99))
     faults = _lower_streaming_faults(cfg, T, chunk_steps)
+    compare_eager = bool(cfg.get("compare_eager", False))
+    if (compare_eager or "loss" in faults) and spec.family != "hybrid":
+        raise ValueError(
+            "loss windows / compare_eager are hybrid-family features "
+            "(only the hybrid model stamps per-chunk ingress loss)"
+        )
     # A staged crash needs a snapshot to come back from; default to
     # every-chunk snapshots so the boundary crash loses nothing.
     snapshot_every = int(
@@ -780,6 +808,7 @@ def compile_streaming_plan(spec: ScenarioSpec) -> StreamingPlan:
         completion_frac=completion_frac,
         faults=faults,
         snapshot_every=snapshot_every,
+        compare_eager=compare_eager,
     )
 
 
@@ -825,5 +854,24 @@ def _lower_streaming_faults(
             )
         faults["clock_skew"] = {
             "at_chunk": at, "skew_s": float(sk.get("skew_s", 0.0)),
+        }
+    if cfg.get("loss") is not None:
+        # Degraded-link window (r16, hybrid plane): chunks in
+        # [start_chunk, stop_chunk) ingest with per-receiver decimation
+        # ``delay`` stamped on the event tensors; the stamp resets to 0 at
+        # stop_chunk so the drain (and any eager twin) runs on clean fabric.
+        lw = dict(cfg["loss"])
+        start = int(lw.get("start_chunk", 0))
+        stop = int(lw.get("stop_chunk", n_chunks))
+        delay = int(lw.get("delay", 1))
+        if delay < 1:
+            raise ValueError("loss.delay must be >= 1 (decimation period)")
+        if not (0 <= start < stop <= n_chunks):
+            raise ValueError(
+                f"loss window [{start}, {stop}) outside the campaign's "
+                f"chunk range [0, {n_chunks}]"
+            )
+        faults["loss"] = {
+            "start_chunk": start, "stop_chunk": stop, "delay": delay,
         }
     return faults

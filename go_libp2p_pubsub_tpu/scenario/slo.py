@@ -242,6 +242,18 @@ def evaluate(
                 "lost_after_restart", "max_lost_after_restart"
             )[-1],
         ))
+    # Hybrid-plane comparative criterion (r16): the runner's eager-forced
+    # twin emits p99_vs_eager_ratio; 0.0 encodes "eager completed fewer
+    # messages than the hybrid" (unboundedly worse tail), which passes any
+    # max-ratio bound.  NaN (twin produced no latencies at all) fails
+    # closed as everywhere else.
+    if slo.max_p99_vs_eager_ratio is not None:
+        crits.append(_crit(
+            "p99_vs_eager_ratio", "max", slo.max_p99_vs_eager_ratio,
+            _streaming_channel(
+                "p99_vs_eager_ratio", "max_p99_vs_eager_ratio"
+            )[-1],
+        ))
 
     return Verdict(
         scenario=spec.name,

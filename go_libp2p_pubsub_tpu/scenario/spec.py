@@ -28,7 +28,7 @@ import json
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
-FAMILIES = ("gossipsub", "treecast", "multitopic", "rlnc")
+FAMILIES = ("gossipsub", "treecast", "multitopic", "rlnc", "hybrid")
 WORKLOAD_KINDS = ("constant", "burst", "hot")
 ATTACK_KINDS = (
     "sybil", "eclipse", "spam", "promise_spam", "graft_spam",
@@ -274,6 +274,13 @@ class SLO:
     # content-hash duplicate counter on this plane.
     max_recovery_s: Optional[float] = None
     max_lost_after_restart: Optional[int] = None
+    # Degraded-links comparison (r16, hybrid streaming runs with
+    # ``compare_eager`` set): ceiling on hybrid p99 ingest→delivery divided
+    # by the eager-forced twin's p99 over the same timeline.  < 1.0 asserts
+    # the adaptive hybrid strictly beat pure eager under the injected loss;
+    # when the eager twin completes FEWER messages than the hybrid the
+    # ratio is reported as 0.0 (unboundedly worse eager tail).
+    max_p99_vs_eager_ratio: Optional[float] = None
 
 
 @dataclass
@@ -321,6 +328,19 @@ class ScenarioSpec:
     #                                 (stall-then-flood)
     #   "clock_skew": {"at_chunk": int, "skew_s": float} — step the host
     #                                 clock the latency stamps read
+    #
+    # Degraded-links keys (r16 adaptive coded gossip, hybrid family):
+    #   "loss": {"start_chunk": int, "stop_chunk": int, "delay": int} —
+    #                                 stamp an all-peer ingress delay for
+    #                                 chunks [start_chunk, stop_chunk) and
+    #                                 reset to 0 after; ``delay`` semantics
+    #                                 are per-family (pend-hold for
+    #                                 multitopic, DECIMATION loss for the
+    #                                 hybrid — the r11 asymmetry)
+    #   "compare_eager": bool       — also run an eager-forced twin engine
+    #                                 (switch thresholds pinned above 1.0)
+    #                                 over the same timeline and emit the
+    #                                 ``p99_vs_eager_ratio`` channel
     streaming: Optional[Dict[str, Any]] = None
     slo: SLO = field(default_factory=SLO)
     description: str = ""

@@ -61,11 +61,11 @@ class StreamingPlaneError(RuntimeError):
 
 
 def streaming_supported(spec: ScenarioSpec) -> bool:
-    """Can this spec run on the streaming plane?  It needs the resident
-    multitopic engine and an explicit ``streaming`` config block."""
+    """Can this spec run on the streaming plane?  It needs a resident
+    engine family and an explicit ``streaming`` config block."""
     return (
         spec.streaming is not None
-        and spec.family == "multitopic"
+        and spec.family in ("multitopic", "hybrid")
         and not spec.churn
         and not spec.attacks
         and not spec.links
@@ -148,6 +148,18 @@ def run_streaming_scenario(
         engine.warmup()
     except Exception as e:
         raise StreamingPlaneError(f"engine warmup failed: {e}") from e
+
+    # Degraded-link window (r16, hybrid plane): the stamp is re-asserted
+    # before EVERY chunk off the runner's own monotone chunk counter, so a
+    # staged crash (which rewinds the engine's chunk count) cannot shift
+    # the window, and the post-window / drain chunks run on clean fabric.
+    loss_w = faults.get("loss")
+
+    def _stamp_loss(eng, ci: int) -> None:
+        if loss_w is None:
+            return
+        inside = loss_w["start_chunk"] <= ci < loss_w["stop_chunk"]
+        eng.set_ingress_delay(loss_w["delay"] if inside else 0)
 
     watchdog: Optional[Watchdog] = None
     if "crash_at_chunk" in faults:
@@ -243,6 +255,7 @@ def run_streaming_scenario(
                     pipe.submit(env, ctx=ctx)
         pipe.flush()
         depth_series.append(holder["ring"].depth)
+        _stamp_loss(engine, chunk_index)
         engine.run_chunk()
         chunk_index += 1
         if faults.get("crash_at_chunk") == chunk_index:
@@ -273,10 +286,79 @@ def run_streaming_scenario(
             engine.completed / max(1, len(engine.publish_log))
         )
 
+    _stamp_loss(engine, chunk_index)  # drain runs on clean fabric
     engine.run_until_drained(max_chunks=max_drain_chunks)
     acct = ring.accounting()
     lats = engine.latencies_s
     q = engine.latency_quantiles()
+
+    # compare_eager (r16): replay the SAME timeline and loss windows through
+    # an eager-forced twin — the identical hybrid model with switch
+    # thresholds above 1.0, so loss_ewma (a probability) can never cross
+    # them and every edge stays on the eager plane.  The twin is a perf
+    # baseline, not a crypto exercise: publishes go straight to its ring
+    # with the spec's validity bit (the main run already proved the
+    # pipeline produces those verdicts), and crash/verifier faults are NOT
+    # replayed — the ratio isolates the coding gain under loss.
+    eager_p99 = float("nan")
+    eager_completed = 0
+    p99_ratio = float("nan")
+    if plan.compare_eager:
+        from ..serve import IngestRing as _Ring
+        from ..serve import StreamingEngine as _Engine
+
+        eager_spec = dataclasses.replace(
+            spec,
+            model={**dict(spec.model), "switch_hi": 2.0, "switch_lo": 1.5},
+        )
+        try:
+            eager_model = build_model(eager_spec)
+        except Exception as e:
+            raise StreamingPlaneError(
+                f"eager twin model build failed: {e}"
+            ) from e
+        ering = _Ring(
+            capacity=plan.capacity, policy=plan.policy, clock=clock
+        )
+        eeng = _Engine(
+            eager_model,
+            ering,
+            chunk_steps=plan.chunk_steps,
+            pub_width=plan.pub_width,
+            completion_frac=plan.completion_frac,
+            seed=spec.seed,
+            clock=clock,
+        )
+        try:
+            eeng.warmup()
+        except Exception as e:
+            raise StreamingPlaneError(
+                f"eager twin warmup failed: {e}"
+            ) from e
+        eseq = 0
+        eci = 0
+        for base in range(0, T, plan.chunk_steps):
+            for t in range(base, min(base + plan.chunk_steps, T)):
+                for topic, src, valid in plan.timeline[t]:
+                    ering.push(
+                        topic=topic, payload=b"stream-%d" % eseq,
+                        publisher=src, valid=valid, timeout=5.0,
+                    )
+                    eseq += 1
+            _stamp_loss(eeng, eci)
+            eeng.run_chunk()
+            eci += 1
+        _stamp_loss(eeng, eci)
+        eeng.run_until_drained(max_chunks=max_drain_chunks)
+        eager_p99 = eeng.latency_quantiles()["p99"]
+        eager_completed = eeng.completed
+        if eager_completed < engine.completed:
+            # Eager never finished messages the hybrid delivered: its tail
+            # is unboundedly worse.  Report 0.0 so a max-ratio SLO passes
+            # (NaN would fail closed and hide the win).
+            p99_ratio = 0.0
+        elif eager_p99 > 0.0 and np.isfinite(eager_p99):
+            p99_ratio = q["p99"] / eager_p99
 
     # Exactly-once floor: every admitted valid message must end the run
     # delivered, deduplicated, in flight, still queued, or attributed to a
@@ -314,6 +396,9 @@ def run_streaming_scenario(
             [engine.duplicate_completions], np.int64
         ),
     }
+    if plan.compare_eager:
+        record["eager_p99_s"] = np.asarray([eager_p99], np.float64)
+        record["p99_vs_eager_ratio"] = np.asarray([p99_ratio], np.float64)
     verdict = slo_mod.evaluate(spec, record, plan.n_publishes)
     if ckpt_dir is not None:
         shutil.rmtree(ckpt_dir, ignore_errors=True)
@@ -344,6 +429,7 @@ def run_streaming_scenario(
                 watchdog.engine_restarts if watchdog is not None else 0
             ),
             "recovery_s_list": list(recovery_s_list),
+            "eager_completed": eager_completed,
             "pipeline": dict(pipe.stats),
         },
         seconds=time.monotonic() - t0,

@@ -158,6 +158,13 @@ class StreamingEngine:
         self._seen_hashes: set = set()        # every VALID publish, ever
         self._completed_hashes: set = set()   # every completed content
         self.flight_tail: Dict[str, np.ndarray] = {}
+        # Degraded-links knob: when set, every chunk's first event row
+        # carries this ingress delay for all peers (schedule ``delay``
+        # semantics are per-family: pend-hold for multitopic, decimation
+        # loss for the hybrid).  The set is idempotent device-side, so
+        # re-stamping each chunk keeps restarts and restores consistent
+        # with whatever the runner last requested.
+        self.ingress_delay: Optional[int] = None
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -238,7 +245,12 @@ class StreamingEngine:
     def _model_key(self) -> str:
         """Config fingerprint stored in checkpoint meta — a sanity check
         that a snapshot is restored onto an equal model (the array
-        shape/dtype validation in utils.checkpoint does the heavy part)."""
+        shape/dtype validation in utils.checkpoint does the heavy part).
+        Models with their own fingerprint (the coded hybrid) provide
+        ``stream_model_key``; the default is the multitopic form."""
+        fn = getattr(self.model, "stream_model_key", None)
+        if fn is not None:
+            return fn()
         m = self.model
         return (
             f"multitopic t={m.t} n={m.n} k={m.k} m={m.m} w={m.w} "
@@ -288,7 +300,16 @@ class StreamingEngine:
             "seen_hashes": sorted(self._seen_hashes),
             "completed_hashes": sorted(self._completed_hashes),
             "ring": self.ring.snapshot(),
+            "ingress_delay": self.ingress_delay,
         }
+        # Coded models expose decode progress — recorded so an operator
+        # (and the crash tests) can see partial ranks were checkpointed
+        # mid-generation, not just full decodes.
+        rank_fn = getattr(self.model, "decode_rank_summary", None)
+        if rank_fn is not None:
+            meta["decode_ranks"] = {
+                k: int(v) for k, v in rank_fn(self.state).items()
+            }
         ckpt.save(
             path,
             {"state": self.state, "flight_tail": dict(self.flight_tail)},
@@ -371,6 +392,8 @@ class StreamingEngine:
         self.latencies_s = [float(x) for x in meta["latencies_s"]]
         self._seen_hashes = set(meta["seen_hashes"])
         self._completed_hashes = set(meta["completed_hashes"])
+        if meta.get("ingress_delay") is not None:
+            self.ingress_delay = int(meta["ingress_delay"])
         replayed = self.ring.restore_snapshot(meta["ring"])
         self.restores += 1
         if self.metrics is not None:
@@ -393,10 +416,20 @@ class StreamingEngine:
 
     # -- internals ----------------------------------------------------------
 
+    def set_ingress_delay(self, delay: Optional[int]) -> None:
+        """Set (or clear with ``None``) the all-peer ingress delay stamped
+        into each subsequent chunk.  Pass 0 to actively RESET peers to the
+        lossless fabric — the device state latches the last set value, so
+        clearing to ``None`` merely stops re-stamping."""
+        self.ingress_delay = None if delay is None else int(delay)
+
     def _empty_events(self) -> sched.MultiTopicEvents:
-        return sched.empty_multitopic_events(
+        ev = sched.empty_multitopic_events(
             self.chunk_steps, self.model.n, self.pub_width
         )
+        if self.ingress_delay is not None:
+            ev.delay[0, :] = self.ingress_delay
+        return ev
 
     def _alloc_slot(self, item: IngestItem) -> int:
         slot = self._next_slot[item.topic]

@@ -21,6 +21,33 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache, repo-local and gitignored.  The suite is
+# compile-dominated (the heaviest fixtures spend minutes in backend_compile)
+# and _fresh_jit_caches_per_module below deliberately drops the in-memory
+# jit caches at every module boundary, so identical programs recompile many
+# times per run and on every run.  The disk cache absorbs both: a warm run
+# skips every previously seen heavyweight compilation, which keeps the
+# tier-1 wall clock inside its timeout on a 1-CPU box and makes it far less
+# load-sensitive.  Cold runs (fresh checkout) just repopulate it.
+#
+# The 10 s floor is load-bearing, not a disk-space tweak: the CPU backend
+# has been observed to SEGFAULT *executing* a deserialized StreamingEngine
+# chunk executable (donated multitopic state; reproduced deterministically
+# on test_crash_safety.py::test_snapshot_restore_exactly_once_no_recompile
+# with an unconditional cache).  Serving-plane chunk compiles are ~6 s, the
+# pure-rollout whales (campaign fixtures, GF(256) elimination, placement
+# sweeps) are 15-70 s each, so the floor keeps every chunk executable out
+# of the cache — they always compile fresh and execute in-memory — while
+# the whales, which round-trip safely, get cached.  The config must be set
+# before the first compilation: jax initializes the cache once, lazily, and
+# ignores later config updates (verified on 0.4.37).
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".cache", "jax-xla"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 10.0)
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
@@ -35,6 +62,7 @@ def _fresh_jit_caches_per_module():
     interpret-mode pallas rollout), each of which passes standalone.
     Dropping the jit caches at every module boundary keeps the compiler's
     working set bounded for the full-suite run; the cost is re-compiling
-    shared helpers per module (~minutes over the whole suite)."""
+    shared helpers per module — which the persistent compilation cache
+    above absorbs for the heavyweight programs."""
     jax.clear_caches()
     yield

@@ -458,4 +458,112 @@ def test_fuzz_cli_streaming_plane_end_to_end():
     for e in out["trajectory"]:
         assert e["status"] in ("red", "green", "invalid")
         assert e["kind"] in ("engine_crash", "verifier_crash",
-                             "producer_stall", "clock_skew", "no_fault")
+                             "producer_stall", "clock_skew", "no_fault",
+                             "degraded_links", "crash_mid_generation")
+
+
+# ---------------------------------------------------------------------------
+# r16: RLNC decode-state crash safety (hybrid serving plane)
+# ---------------------------------------------------------------------------
+
+_HYBRID_TINY = dict(n_peers=16, n_slots=8, conn_degree=4, msg_window=8,
+                    heartbeat_steps=4, gen_size=4)
+
+
+@pytest.mark.slow
+def test_decode_basis_checkpoint_roundtrip_every_rank(tmp_path):
+    """A generation checkpointed at EVERY partial rank r in 0..Kg-1 comes
+    back leaf-identical through utils.checkpoint: restored rank == r, and
+    the restored basis accepts exactly the remaining Kg - r independent
+    rows to finish the decode — no rank lost, none invented."""
+    import jax.numpy as jnp
+
+    from go_libp2p_pubsub_tpu.ops import gf256
+
+    kg = _HYBRID_TINY["gen_size"]
+    rng = np.random.default_rng(5)
+    for rank in range(kg):
+        b = jnp.zeros((kg, kg), jnp.uint8)
+        while int(gf256.gf_rank(b)) < rank:
+            v = jnp.asarray(rng.integers(0, 256, kg, dtype=np.uint8))
+            b = gf256.rref_insert(b, v)[0]
+        path = str(tmp_path / f"basis-{rank}.ckpt")
+        checkpoint.save(path, {"basis": b}, meta={"rank": rank})
+        assert checkpoint.meta(path)["rank"] == rank
+        back = checkpoint.restore(path, {"basis": b})["basis"]
+        assert np.array_equal(np.asarray(back), np.asarray(b)), \
+            f"basis at rank {rank} not byte-identical across restore"
+        assert int(gf256.gf_rank(back)) == rank
+        inserted = 0
+        while int(gf256.gf_rank(back)) < kg:
+            v = jnp.asarray(rng.integers(0, 256, kg, dtype=np.uint8))
+            back, ok = gf256.rref_insert(back, v)
+            inserted += int(np.asarray(ok))
+        assert inserted == kg - rank, \
+            "restored basis did not resume decode at its partial rank"
+
+
+@pytest.mark.slow
+def test_hybrid_engine_crash_restores_partial_decode_state(tmp_path):
+    """Engine-level mid-generation crash: snapshot while generations sit at
+    PARTIAL rank under ingress loss, kill the engine, restore a fresh one
+    — the decode basis comes back leaf-identical (resume, don't restart
+    the generation), the drain completes every accepted message exactly
+    once, and the compile cache never grows."""
+    from go_libp2p_pubsub_tpu.models.hybrid import HybridGossipSub
+
+    model = HybridGossipSub(**_HYBRID_TINY)
+    path = str(tmp_path / "engine.ckpt")
+    eng1, ring1 = _pair(model)
+    eng1.warmup()
+    eng1.set_ingress_delay(2)
+    for i in range(4):
+        ring1.push(topic=0, payload=b"coded %d" % i, publisher=i)
+    eng1.run_chunk()
+    eng1.run_chunk()
+    ranks = model.decode_rank_summary(eng1.state)
+    assert ranks["partial"] > 0, \
+        "fixture failed to park a generation at partial rank"
+    eng1.snapshot(path)
+    assert checkpoint.meta(path)["decode_ranks"]["partial"] > 0
+    basis_before = np.asarray(eng1.state.basis).copy()
+
+    eng2, _ = _pair(model)
+    eng2.warmup()
+    eng2.restore(path)
+    assert np.array_equal(np.asarray(eng2.state.basis), basis_before), \
+        "decode basis not restored leaf-identical"
+    assert eng2.compile_cache_size() == 1, "restore recompiled"
+    # Loss window over (clean drain), exactly-once completion.
+    eng2.set_ingress_delay(0)
+    eng2.run_until_drained(max_chunks=32)
+    assert eng2.completed == 4, "lost messages across mid-generation crash"
+    assert eng2.duplicate_completions == 0
+    assert eng2.compile_cache_size() == 1
+
+
+@pytest.mark.slow
+def test_hybrid_runner_crash_canon_green():
+    """The registered canon end to end through the streaming runner: crash
+    mid-generation under a loss window, restored engine finishes delivery
+    with the r14 crash contract intact."""
+    spec = scenario.CANON["streaming_rlnc_crash_recovery"]()
+    res = scenario.run_streaming_scenario(spec)
+    assert res.verdict.passed, str(res.verdict)
+    assert res.engine_stats["restores"] == 1
+    assert res.engine_stats["compile_cache_size"] == 1
+    assert res.record["lost_after_restart"][-1] == 0
+    assert res.record["duplicate_deliveries"][-1] == 0
+
+
+@pytest.mark.slow
+def test_hybrid_runner_degraded_links_canon_beats_eager():
+    """The comparative canon end to end: the adaptive plane's p99 must
+    beat the eager-forced twin on the identical timeline (ratio < 1, or
+    the 0.0 sentinel when eager never finishes)."""
+    spec = scenario.CANON["streaming_degraded_links"]()
+    res = scenario.run_streaming_scenario(spec)
+    assert res.verdict.passed, str(res.verdict)
+    ratio = float(res.record["p99_vs_eager_ratio"][-1])
+    assert 0.0 <= ratio < 1.0
+    assert res.record["silent_drops"][-1] == 0

@@ -1,0 +1,267 @@
+"""Adaptive coded gossip (r16): per-edge eager<->RLNC switching.
+
+The contracts under test, in order of importance:
+
+1. BIT-IDENTITY GUARD: on a clean fabric the hybrid is leaf-for-leaf
+   identical to a plain GossipSub run — embedded gossip state AND every
+   shared flight-recorder channel.  The adaptive machinery must be a true
+   no-op until an edge actually switches (the masks are value-level
+   identities, the coded plane is lax.cond-gated off, and the coded PRNG
+   chain is separate from the gossip chain).
+2. The per-edge loss estimator: EWMA converges to the true loss rate,
+   stays exactly 0.0 on clean fabric, and the hysteresis band prevents
+   flapping between the thresholds.
+3. Under ingress decimation the adaptive plane delivers where forced
+   eager collapses, and the switch is observable (coded_edges channel,
+   loss_ewma crossing switch_hi).
+4. The MXU GF(256) decode path is bit-exact with the table path through a
+   full rollout (same final state, not just the same microbench output).
+
+The rollout-bearing tests compile small scans and are slow-tier; the
+estimator unit tests and scenario-plane validation are host-cheap and run
+in tier 1.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+# Small mesh: big enough for a real epidemic (diameter > 1 heartbeat),
+# small enough that the coded plane's [N, K, M, Kg] fragment tensor stays
+# trivial on CPU.
+_TINY = dict(n_peers=16, n_slots=8, conn_degree=4, msg_window=8,
+             heartbeat_steps=4, gen_size=4)
+_STEPS = 24
+
+
+def _publish_all(model, st, seed=3):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    srcs = rng.integers(model.n, size=model.m)
+    for slot in range(model.m):
+        st = model.publish(st, jnp.int32(int(srcs[slot])),
+                           jnp.int32(slot), jnp.asarray(True))
+    return st
+
+
+# ---------------------------------------------------------------------------
+# loss estimator (tier 1: tiny eager elementwise ops, no scan)
+# ---------------------------------------------------------------------------
+
+
+def test_ewma_converges_to_loss_rate():
+    import jax.numpy as jnp
+
+    from go_libp2p_pubsub_tpu.ops import loss_estimator as le
+
+    loss = jnp.zeros((1, 1), jnp.float32)
+    expected = jnp.ones((1, 1), bool)
+    # Deterministic decimation delay=2: observed 1 round in 3.
+    for step in range(60):
+        observed = jnp.full((1, 1), step % 3 == 0)
+        loss = le.ewma_update(loss, expected, observed, alpha=0.25)
+    assert abs(float(loss[0, 0]) - 2.0 / 3.0) < 0.15
+
+
+def test_ewma_frozen_when_nothing_expected():
+    import jax.numpy as jnp
+
+    from go_libp2p_pubsub_tpu.ops import loss_estimator as le
+
+    loss = jnp.full((2, 2), 0.5, jnp.float32)
+    out = le.ewma_update(loss, jnp.zeros((2, 2), bool),
+                         jnp.zeros((2, 2), bool), alpha=0.25)
+    # No traffic expected -> no evidence -> the estimate must not move
+    # (otherwise idle edges decay to "clean" and flap back on next loss).
+    assert np.array_equal(np.asarray(out), np.full((2, 2), 0.5, np.float32))
+
+
+def test_hysteresis_band_prevents_flapping():
+    import jax.numpy as jnp
+
+    from go_libp2p_pubsub_tpu.ops import loss_estimator as le
+
+    hi, lo = 0.35, 0.15
+    mid = jnp.full((1, 1), 0.25, jnp.float32)  # inside the band
+    for coded0 in (False, True):
+        coded = jnp.full((1, 1), coded0)
+        out = le.hysteresis_switch(mid, coded, hi, lo)
+        assert bool(out[0, 0]) == coded0, "band value flipped the mode"
+    # Outside the band the switch is decisive in both directions.
+    assert bool(le.hysteresis_switch(
+        jnp.full((1, 1), 0.5, jnp.float32), jnp.full((1, 1), False), hi, lo
+    )[0, 0])
+    assert not bool(le.hysteresis_switch(
+        jnp.full((1, 1), 0.05, jnp.float32), jnp.full((1, 1), True), hi, lo
+    )[0, 0])
+
+
+# ---------------------------------------------------------------------------
+# scenario-plane validation (tier 1: pure host, no device work)
+# ---------------------------------------------------------------------------
+
+
+def test_hybrid_family_is_streaming_only():
+    from go_libp2p_pubsub_tpu import scenario
+    from go_libp2p_pubsub_tpu.scenario.spec import SLO, ScenarioSpec, Workload
+
+    spec = ScenarioSpec(
+        name="t", family="hybrid", n_steps=16, seed=0,
+        model=dict(_TINY),
+        workloads=[Workload(kind="burst", topic=0, start=0, n_msgs=2)],
+        slo=SLO(min_delivery_frac=0.5),
+    )
+    with pytest.raises(ValueError, match="streaming-only"):
+        scenario.compile_scenario(spec)
+    # The same campaign WITH a streaming block lowers fine.
+    ok = dataclasses.replace(spec, streaming={"streaming_only": True,
+                                              "chunk_steps": 8})
+    assert scenario.compile_streaming_plan(ok).n_publishes == 2
+
+
+def test_loss_window_lowering_validates():
+    from go_libp2p_pubsub_tpu import scenario
+    from go_libp2p_pubsub_tpu.scenario.spec import SLO, ScenarioSpec, Workload
+
+    def spec(family, streaming):
+        return ScenarioSpec(
+            name="t", family=family, n_steps=16, seed=0,
+            model=(dict(_TINY) if family == "hybrid"
+                   else dict(n_topics=2, n_peers=16)),
+            workloads=[Workload(kind="burst", topic=0, start=0, n_msgs=2)],
+            streaming=dict({"streaming_only": True, "chunk_steps": 8},
+                           **streaming),
+            slo=SLO(min_delivery_frac=0.5),
+        )
+
+    with pytest.raises(ValueError, match="delay"):
+        scenario.compile_streaming_plan(spec("hybrid", {
+            "loss": {"start_chunk": 0, "stop_chunk": 1, "delay": 0}}))
+    with pytest.raises(ValueError, match="loss window"):
+        scenario.compile_streaming_plan(spec("hybrid", {
+            "loss": {"start_chunk": 1, "stop_chunk": 9, "delay": 2}}))
+    # Loss windows / compare_eager are hybrid-only features.
+    with pytest.raises(ValueError, match="hybrid-family"):
+        scenario.compile_streaming_plan(spec("multitopic", {
+            "loss": {"start_chunk": 0, "stop_chunk": 1, "delay": 2}}))
+    with pytest.raises(ValueError, match="hybrid-family"):
+        scenario.compile_streaming_plan(spec("multitopic",
+                                             {"compare_eager": True}))
+    plan = scenario.compile_streaming_plan(spec("hybrid", {
+        "loss": {"start_chunk": 0, "stop_chunk": 2, "delay": 2},
+        "compare_eager": True}))
+    assert plan.faults["loss"] == {"start_chunk": 0, "stop_chunk": 2,
+                                   "delay": 2}
+    assert plan.compare_eager
+
+
+def test_new_canons_registered_and_streaming_supported():
+    from go_libp2p_pubsub_tpu import scenario
+    from go_libp2p_pubsub_tpu.scenario import canon
+
+    for name in ("streaming_degraded_links", "streaming_rlnc_crash_recovery"):
+        spec = canon.CANON[name]()
+        assert spec.family == "hybrid"
+        assert scenario.streaming_supported(spec)
+        # JSON round-trip: specs stay pure data with the new keys.
+        from go_libp2p_pubsub_tpu.scenario.spec import ScenarioSpec
+        assert ScenarioSpec.from_json(spec.to_json()) == spec
+
+
+# ---------------------------------------------------------------------------
+# rollout contracts (slow tier: these compile real scans)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_clean_fabric_bit_identity_with_plain_gossipsub():
+    """The tentpole guard: all-clean hybrid == plain GossipSub, leaf for
+    leaf, flight-recorder channels included.  Any regression in the mask
+    plumbing, the cond gating, or the PRNG chain separation shows up here
+    as a single differing bit."""
+    import jax
+
+    from go_libp2p_pubsub_tpu.models.gossipsub import GossipSub
+    from go_libp2p_pubsub_tpu.models.hybrid import HybridGossipSub
+
+    gs_kw = {k: v for k, v in _TINY.items() if k != "gen_size"}
+    hy = HybridGossipSub(**_TINY)
+    gs = GossipSub(**gs_kw, use_pallas=False)
+
+    h_st = _publish_all(hy, hy.init(seed=0))
+    g_st = _publish_all(gs, gs.init(seed=0))
+
+    h_out, h_rec = hy.rollout(h_st, _STEPS, record=True)
+    g_out, g_rec = gs.rollout(g_st, _STEPS, record=True)
+
+    # Embedded gossip state: leaf-for-leaf identical.
+    h_leaves = jax.tree_util.tree_leaves(h_out.gossip)
+    g_leaves = jax.tree_util.tree_leaves(g_out)
+    assert len(h_leaves) == len(g_leaves)
+    for hl, gl in zip(h_leaves, g_leaves):
+        assert np.array_equal(np.asarray(hl), np.asarray(gl)), \
+            "clean-fabric hybrid diverged from plain GossipSub"
+
+    # Shared flight channels identical; hybrid-only channels quiescent.
+    for key, gv in g_rec.items():
+        assert np.array_equal(np.asarray(h_rec[key]), np.asarray(gv)), \
+            f"flight channel {key!r} diverged on clean fabric"
+    assert int(np.asarray(h_rec["coded_edges"]).max()) == 0
+    assert float(np.asarray(h_rec["loss_ewma_mean"]).max()) == 0.0
+    # The adaptive leaves never moved off init.
+    assert not bool(np.asarray(h_out.coded).any())
+    assert float(np.asarray(h_out.loss_ewma).max()) == 0.0
+
+
+@pytest.mark.slow
+def test_adaptive_switches_and_delivers_under_decimation():
+    """Under uniform ingress decimation the estimator crosses switch_hi,
+    edges flip to the coded plane, and delivery completes where the
+    eager-forced twin collapses."""
+    from go_libp2p_pubsub_tpu.models.hybrid import HybridGossipSub
+
+    adaptive = HybridGossipSub(**_TINY)
+    eager = HybridGossipSub(**_TINY, switch_hi=2.0, switch_lo=1.5)
+
+    def run(model):
+        st = _publish_all(model, model.init(seed=0))
+        st = model.set_ingress_loss(st, 2)
+        out, rec = model.rollout(st, 2 * _STEPS, record=True)
+        frac, _, p99 = model.delivery_stats(out)
+        return (out, rec, float(np.nanmean(np.asarray(frac))),
+                float(np.nanmean(np.asarray(p99))))
+
+    a_out, a_rec, a_frac, a_p99 = run(adaptive)
+    _, e_rec, e_frac, _ = run(eager)
+
+    assert int(np.asarray(a_rec["coded_edges"])[-1]) > 0, "no edge switched"
+    assert float(np.asarray(a_out.loss_ewma).max()) > adaptive.switch_hi
+    assert int(np.asarray(e_rec["coded_edges"]).max()) == 0
+    assert a_frac == 1.0, f"adaptive plane failed to deliver ({a_frac})"
+    assert a_frac > e_frac + 0.5, \
+        f"adaptive ({a_frac}) should dominate forced eager ({e_frac})"
+    assert np.isfinite(a_p99)
+
+
+@pytest.mark.slow
+def test_mxu_decode_path_bit_exact_through_rollout():
+    """use_mxu flips the GF(256) combine to the int8-dot decomposition;
+    the whole rollout — basis fold included — must be bit-identical."""
+    import jax
+
+    from go_libp2p_pubsub_tpu.models.hybrid import HybridGossipSub
+
+    a = HybridGossipSub(**_TINY, use_mxu=False)
+    b = HybridGossipSub(**_TINY, use_mxu=True)
+    sta = _publish_all(a, a.init(seed=0))
+    stb = _publish_all(b, b.init(seed=0))
+    sta = a.set_ingress_loss(sta, 2)
+    stb = b.set_ingress_loss(stb, 2)
+    out_a, _ = a.rollout(sta, _STEPS, record=True)
+    out_b, _ = b.rollout(stb, _STEPS, record=True)
+    for la, lb in zip(jax.tree_util.tree_leaves(out_a),
+                      jax.tree_util.tree_leaves(out_b)):
+        assert np.array_equal(np.asarray(la), np.asarray(lb)), \
+            "MXU decode path diverged from the table path"

@@ -414,17 +414,18 @@ def test_live_only_canon_flagged_and_filtered():
         assert s.live_only
         assert not scenario.sim_supported(s)
         assert scenario.live_supported(s)
-    for name in ("streaming_steady", "streaming_burst_overload",
-                 "streaming_engine_crash_recovery",
-                 "streaming_verifier_crash"):
+    streaming_only = ("streaming_steady", "streaming_burst_overload",
+                      "streaming_engine_crash_recovery",
+                      "streaming_verifier_crash",
+                      "streaming_degraded_links",
+                      "streaming_rlnc_crash_recovery")
+    for name in streaming_only:
         s = scenario.build(name)
         assert s.streaming_only
         assert not scenario.sim_supported(s)
         assert scenario.streaming_supported(s)
     single_plane = ("root_kill_failover", "live_partition_heal",
-                    "streaming_steady", "streaming_burst_overload",
-                    "streaming_engine_crash_recovery",
-                    "streaming_verifier_crash")
+                    *streaming_only)
     assert all(scenario.sim_supported(s)
                for s in scenario.build_all()
                if s.name not in single_plane)

@@ -88,6 +88,31 @@ def test_all_families_trace_smoke():
     )
     jax.eval_shape(rl_mxu.step, jax.eval_shape(lambda: rl_mxu.init(seed=0)))
 
+    # -- hybrid: adaptive coded gossip (r16) flag rotation ------------------
+    # Same flag-rot posture as the r15 paths: both GF(256) decode paths
+    # must TRACE (they produce structurally different jaxprs); the
+    # eager-forced twin's thresholds are trace-identical constants, so it
+    # only needs ctor validation here — the tier-1 budget is nearly at the
+    # 870 s cap and every trace pass below costs real seconds.  Full
+    # rollouts of every regime run in the slow tier (tests/test_hybrid.py).
+    from go_libp2p_pubsub_tpu.models.hybrid import HybridGossipSub
+
+    HybridGossipSub(  # eager-forced twin: ctor-validates the threshold band
+        n_peers=16, n_slots=8, conn_degree=4, msg_window=4, gen_size=2,
+        switch_hi=2.0, switch_lo=1.5,
+    )
+    for hy_kw in ({"use_mxu": False}, {"use_mxu": True}):
+        hy = HybridGossipSub(
+            n_peers=16, n_slots=8, conn_degree=4, msg_window=4, gen_size=2,
+            **hy_kw,
+        )
+        hy_st = jax.eval_shape(lambda m=hy: m.init(seed=0))
+        jax.eval_shape(hy.step, hy_st)
+    jax.eval_shape(
+        hy.publish, hy_st, jnp.int32(0), jnp.int32(0), jnp.asarray(True)
+    )
+    jax.eval_shape(hy.step_recorded, hy_st)
+
     from go_libp2p_pubsub_tpu.ops import ed25519 as ed
 
     def _bm_kernel():

@@ -120,6 +120,29 @@ SCALAR_ROWS: List[Tuple[Tuple[str, ...], str, bool]] = [
      "streaming shed (priority)", False),
     (("streaming", "degraded", "dropped_oldest"),
      "streaming dropped (oldest)", False),
+    # Adaptive coded gossip section (r16+); same warn-not-crash behavior
+    # as sharded/rlnc/streaming when a record predates it.  The headline is
+    # the crossover loss rate (lower = the adaptive plane starts winning
+    # earlier); the d1/d2 rows pin the sweep's interesting interior points,
+    # and the coded_serving rows carry the two r16 canons' crash-recovery
+    # and eager-comparison measurements.
+    (("hybrid", "value"), "hybrid crossover loss frac", False),
+    (("hybrid", "by_delay", "d1", "adaptive", "delivery_frac"),
+     "hybrid d1 adaptive delivery frac", True),
+    (("hybrid", "by_delay", "d1", "adaptive", "p99_latency_rounds"),
+     "hybrid d1 adaptive p99 (rounds)", False),
+    (("hybrid", "by_delay", "d1", "eager_forced", "delivery_frac"),
+     "hybrid d1 eager delivery frac", True),
+    (("hybrid", "by_delay", "d2", "adaptive", "p99_latency_rounds"),
+     "hybrid d2 adaptive p99 (rounds)", False),
+    (("hybrid", "coded_serving", "p99_vs_eager_ratio"),
+     "coded serving p99 vs eager ratio", False),
+    (("hybrid", "coded_serving", "recovery_s"),
+     "coded serving recovery (s)", False),
+    (("hybrid", "coded_serving", "lost_after_restart"),
+     "coded serving lost after restart", False),
+    (("hybrid", "coded_serving", "duplicate_deliveries"),
+     "coded serving duplicate deliveries", False),
     # Scenario-canon inventory section (r13+); same warn-not-crash behavior
     # as sharded/rlnc/streaming when a record lacks it.
     (("scenario_canon", "count"), "canon scenario count", True),
@@ -337,6 +360,27 @@ def context_warnings(old: Dict[str, Any], new: Dict[str, Any]) -> List[str]:
                     f"(missing in {which}; added in r14) — its rows are "
                     f"one-sided"
                 )
+    # Adaptive coded gossip section (r16+): same treatment.
+    ho, hn = old.get("hybrid"), new.get("hybrid")
+    if (ho is None) != (hn is None):
+        which = "old" if ho is None else "new"
+        warns.append(
+            f"only one record has a 'hybrid' section (missing in {which}; "
+            f"added in r16) — hybrid rows are one-sided"
+        )
+    for name, s in (("old", ho), ("new", hn)):
+        if isinstance(s, dict) and "error" in s:
+            warns.append(
+                f"{name} hybrid section is an error record: "
+                f"{str(s['error'])[:120]}"
+            )
+        if (isinstance(s, dict)
+                and isinstance(s.get("coded_serving"), dict)
+                and "error" in s["coded_serving"]):
+            warns.append(
+                f"{name} hybrid coded_serving canons errored: "
+                f"{str(s['coded_serving']['error'])[:120]}"
+            )
     # Hardware-shape restructure keys (r15+): presence mismatch means one
     # record predates the batch-major/fused-prologue/MXU round — the
     # affected rows are one-sided, not a crash.

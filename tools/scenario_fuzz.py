@@ -223,6 +223,14 @@ _STREAM_FUZZ_MESH = dict(
 _STREAM_N_STEPS = 32
 _STREAM_CHUNK_STEPS = 8
 
+# Fixed hybrid serving mesh (r16): same compile-sharing rationale.  Single
+# topic by construction (the hybrid plane is T = 1), small enough that the
+# GF(256) decode fold stays cheap on CPU hunts.
+_HYBRID_FUZZ_MESH = dict(
+    n_peers=32, n_slots=8, conn_degree=6, msg_window=16,
+    heartbeat_steps=4, gen_size=4, switch_hi=0.35, switch_lo=0.15,
+)
+
 
 def streaming_standing_slo(capacity: int, has_crash: bool) -> SLO:
     """The serving-plane invariant grade: conservation exact, delivery
@@ -252,12 +260,17 @@ def sample_streaming_spec(
     are capacity-matched so a single-threaded hunt never parks in the
     ring's blocking push."""
     rng = np.random.default_rng([seed, _TAG_FUZZ, index])
+    # Hybrid-plane draw (r16): a quarter of the hunt runs the adaptive
+    # coded family under a degraded-link window — crash faults landing
+    # inside the window are the crash-MID-GENERATION trajectories (partial
+    # decode ranks in the snapshot).
+    hybrid = bool(rng.random() < 0.25)
     policy = str(rng.choice(["block", "drop_oldest", "reject"]))
     capacity = int(rng.choice([8, 12, 16]))
 
     workloads = []
     per_chunk = 0
-    for topic in range(int(rng.integers(1, 3))):
+    for topic in range(1 if hybrid else int(rng.integers(1, 3))):
         every = int(rng.choice([2, 4]))
         workloads.append(Workload(
             kind="constant", topic=topic, start=topic,
@@ -301,6 +314,15 @@ def sample_streaming_spec(
             "at_chunk": int(rng.integers(1, n_chunks)),
             "skew_s": float(rng.choice([-2.0, -0.5, 0.5, 2.0])),
         }
+    if hybrid:
+        # Always degraded: the last traffic chunk stays clean so the drain
+        # finishes whatever the estimator's switch latency left pending.
+        lo_start = int(rng.integers(0, 2))
+        streaming["loss"] = {
+            "start_chunk": lo_start,
+            "stop_chunk": int(rng.integers(lo_start + 1, n_chunks)),
+            "delay": int(rng.choice([1, 2, 3])),
+        }
     if policy == "block":
         # No blocking stalls in a single-threaded hunt: one flush's worth
         # of pushes (a group, doubled by the verifier retry window, plus
@@ -311,15 +333,16 @@ def sample_streaming_spec(
 
     return ScenarioSpec(
         name=f"fuzz_stream_s{seed}_i{index:04d}",
-        family="multitopic",
+        family="hybrid" if hybrid else "multitopic",
         n_steps=_STREAM_N_STEPS,
         seed=int(rng.integers(0, 2**31 - 1)),
-        model=dict(_STREAM_FUZZ_MESH),
+        model=dict(_HYBRID_FUZZ_MESH if hybrid else _STREAM_FUZZ_MESH),
         workloads=workloads,
         streaming=streaming,
         slo=streaming_standing_slo(capacity, fault == "crash"),
         description=f"fuzzed serving chaos: {fault} fault, {policy} "
-                    f"policy (search seed {seed}, index {index})",
+                    f"policy{', degraded hybrid' if hybrid else ''} "
+                    f"(search seed {seed}, index {index})",
     )
 
 
@@ -486,7 +509,7 @@ def _mutations(spec: ScenarioSpec, plane: str = "sim") -> List[ScenarioSpec]:
         # thin the workload — the minimal red names the one fault + load
         # shape that actually breaks the config.
         cfg = dict(spec.streaming or {})
-        for key in ("clock_skew", "producer_stall",
+        for key in ("clock_skew", "producer_stall", "loss", "compare_eager",
                     "verifier_crash_at_chunk", "crash_at_chunk"):
             if key in cfg:
                 smaller = {
@@ -600,8 +623,11 @@ def _spec_kind(spec: ScenarioSpec, plane: str) -> str:
             ("verifier_crash_at_chunk", "verifier_crash"),
             ("producer_stall", "producer_stall"),
             ("clock_skew", "clock_skew"),
+            ("loss", "degraded_links"),
         ):
             if key in cfg:
+                if key == "crash_at_chunk" and "loss" in cfg:
+                    return "crash_mid_generation"
                 return label
         return "no_fault"
     if plane == "live":
