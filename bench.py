@@ -94,15 +94,21 @@ RLNC_RUN_TIMEOUT_S = 900.0
 # Adaptive coded gossip crossover (BENCH_MODE=hybrid): the per-edge
 # eager<->RLNC switcher vs an eager-forced twin (same HybridGossipSub class
 # with switch thresholds above 1.0, so the loss EWMA — a probability — can
-# never flip an edge) on the IDENTICAL fixed-seed topology, swept over
-# uniform ingress-decimation delays.  loss_frac = d / (d + 1); the reported
-# crossover is the smallest swept loss rate where the adaptive plane
-# strictly beats eager (higher delivery, or equal delivery at lower p99
-# rounds).  At d=0 the two are bit-identical by construction (the identity
-# guard in tests/test_hybrid.py), so the row reads as a true tie.
+# never flip an edge) on the IDENTICAL fixed-seed topology, swept over two
+# loss grids.  Decimation delays (the r16 grid, kept for continuity):
+# loss_frac = d / (d + 1), which can only express {0, 1/2, 2/3, 3/4}.
+# Bernoulli probabilities (r17, `bern_ps`): per-receiver per-round drops at
+# rate p on the model's own loss PRNG chain, resolving the crossover BELOW
+# 1/2 — the r16 open remainder.  The reported crossover is the smallest
+# swept loss rate where the adaptive plane strictly beats eager (higher
+# delivery, or equal delivery at lower p99 rounds); the headline value
+# comes from the finer Bernoulli grid.  At d=0 / p=0 the two runs are
+# bit-identical by construction (the identity guard in tests/test_hybrid.py),
+# so those rows read as true ties.
 HYBRID_SCALE = dict(n_peers=256, n_slots=16, degree=8, gen_size=4,
                     msg_window=32, heartbeat_steps=4, steps=32,
-                    topo_seed=0, delays=(0, 1, 2, 3))
+                    topo_seed=0, delays=(0, 1, 2, 3),
+                    bern_ps=(0.125, 0.25, 0.375, 0.5, 0.625))
 HYBRID_RUN_TIMEOUT_S = 900.0
 
 # Streaming serving plane (BENCH_MODE=streaming): ONE resident multitopic
@@ -430,22 +436,29 @@ def native_verify_window(envs, rng):
     return ok[:N_MSGS], charged, NATIVE_BATCH / dt
 
 
-def device_verify_window(envs, pad_to, batch_major=None):
+def device_verify_window(envs, pad_to, batch_major=None, ladder=None,
+                         window=None, reps=1):
     """Verify the window's signatures on the TPU device kernel at batch
     ``pad_to``; returns (verdicts bool[N_MSGS], measured_s, sigs/s).
-    ``batch_major=None`` takes the kernel's per-backend default layout;
-    pass False to time the legacy row-major ladder for the layout A/B."""
+    ``batch_major=None`` / ``ladder=None`` take the kernel's per-backend
+    defaults; pass ``batch_major=False`` to time the legacy row-major
+    layout for the layout A/B, ``ladder="straus"`` / ``"windowed"`` (+
+    ``window``) for the ladder A/B.  ``reps`` > 1 reports best-of-reps
+    (the steady-state number the A/B rows want)."""
     from go_libp2p_pubsub_tpu.crypto.pipeline import signing_bytes
     from go_libp2p_pubsub_tpu.ops import ed25519 as dev
 
     pks = [e.pubkey for e in envs]
     msgs = [signing_bytes(e.topic, e.seqno, e.payload) for e in envs]
     sigs = [e.signature for e in envs]
-    kw = dict(pad_to=pad_to, batch_major=batch_major)
+    kw = dict(pad_to=pad_to, batch_major=batch_major, ladder=ladder,
+              window=window)
     dev.verify_batch(pks, msgs, sigs, **kw)  # compile at this shape
-    t0 = time.perf_counter()
-    verdicts = dev.verify_batch(pks, msgs, sigs, **kw)
-    dt = time.perf_counter() - t0
+    dt = float("inf")
+    for _ in range(max(1, reps)):
+        t0 = time.perf_counter()
+        verdicts = dev.verify_batch(pks, msgs, sigs, **kw)
+        dt = min(dt, time.perf_counter() - t0)
     # The kernel performs pad_to curve verifications (padding included), so
     # pad_to/dt is the kernel's throughput AT THAT BATCH SIZE.
     return verdicts, dt, pad_to / dt
@@ -1094,9 +1107,12 @@ def hybrid_child_main() -> None:
     # switches — pure eager+IWANT through the identical machinery.
     eager = HybridGossipSub(**common, switch_hi=2.0, switch_lo=1.5)
 
-    def measure(model, name, delay):
+    def measure(model, name, delay, bern_p=None):
         st = model.init(seed=cfg["topo_seed"])
-        st = model.set_ingress_loss(st, delay)
+        if bern_p is not None:
+            st = model.set_ingress_loss_p(st, bern_p)
+        else:
+            st = model.set_ingress_loss(st, delay)
         for slot in range(cfg["msg_window"]):
             st = model.publish(
                 st, jnp.int32(int(srcs[slot])), jnp.int32(slot),
@@ -1112,7 +1128,8 @@ def hybrid_child_main() -> None:
         frac, p50, p99 = (np.asarray(x) for x in model.delivery_stats(out))
         mean_frac = float(np.nanmean(frac))
         coded_edges = int(np.asarray(rec["coded_edges"])[-1])
-        log(f"{name}/d={delay}: frac {mean_frac:.4f}  "
+        tag = f"p={bern_p}" if bern_p is not None else f"d={delay}"
+        log(f"{name}/{tag}: frac {mean_frac:.4f}  "
             f"p50 {float(np.nanmean(p50)):.0f} "
             f"p99 {float(np.nanmean(p99)):.0f} rounds  "
             f"coded_edges {coded_edges}  "
@@ -1126,20 +1143,23 @@ def hybrid_child_main() -> None:
             "compile_s": round(compile_s, 1),
         }
 
-    rows = []
-    crossover = None
-    for delay in cfg["delays"]:
-        loss_frac = delay / (delay + 1)
-        a = measure(adaptive, "adaptive", delay)
-        e = measure(eager, "eager_forced", delay)
+    def strict_win(a, e):
         # Strict win: more delivered, or equal delivery at a lower p99.
-        wins = (
+        return (
             a["delivery_frac"] > e["delivery_frac"] + 1e-9
             or (
                 abs(a["delivery_frac"] - e["delivery_frac"]) <= 1e-9
                 and a["p99_latency_rounds"] < e["p99_latency_rounds"]
             )
         )
+
+    rows = []
+    crossover_dec = None
+    for delay in cfg["delays"]:
+        loss_frac = delay / (delay + 1)
+        a = measure(adaptive, "adaptive", delay)
+        e = measure(eager, "eager_forced", delay)
+        wins = strict_win(a, e)
         rows.append({
             "delay": delay,
             "loss_frac": round(loss_frac, 4),
@@ -1147,10 +1167,32 @@ def hybrid_child_main() -> None:
             "eager_forced": e,
             "adaptive_wins": bool(wins),
         })
-        if wins and crossover is None:
-            crossover = round(loss_frac, 4)
+        if wins and crossover_dec is None:
+            crossover_dec = round(loss_frac, 4)
 
-    log(f"crossover loss_frac: {crossover}")
+    log(f"decimation crossover loss_frac: {crossover_dec}")
+
+    # Bernoulli sweep (r17): the finer grid — same compiled rollouts (the
+    # loss probability is state, not config, so no new compiles), same
+    # fixed seed, so both twins see the IDENTICAL drop realization.  The
+    # headline crossover comes from this grid: loss_frac == p exactly.
+    bern_rows = []
+    crossover = None
+    for p in cfg["bern_ps"]:
+        a = measure(adaptive, "adaptive", 0, bern_p=p)
+        e = measure(eager, "eager_forced", 0, bern_p=p)
+        wins = strict_win(a, e)
+        bern_rows.append({
+            "p": p,
+            "loss_frac": round(p, 4),
+            "adaptive": a,
+            "eager_forced": e,
+            "adaptive_wins": bool(wins),
+        })
+        if wins and crossover is None:
+            crossover = round(p, 4)
+
+    log(f"bernoulli crossover loss_frac: {crossover}")
 
     # Coded-serving recovery channels: run the two r16 canons through the
     # real streaming runner so the bench record carries the crash-recovery
@@ -1194,19 +1236,28 @@ def hybrid_child_main() -> None:
             {
                 "metric": "hybrid_crossover_loss_frac",
                 "value": crossover if crossover is not None else -1.0,
+                "crossover_decimation": (
+                    crossover_dec if crossover_dec is not None else -1.0
+                ),
                 "unit": "loss_frac",
-                "methodology_version": 1,
+                "methodology_version": 2,
                 "n_peers": n_peers,
                 "gen_size": cfg["gen_size"],
                 "rollout_steps": steps,
                 "backend": backend,
                 "topo_seed": cfg["topo_seed"],
                 "loss_semantics": (
-                    "uniform per-receiver ingress decimation: "
-                    "accept iff step % (d+1) == 0; loss_frac = d/(d+1)"
+                    "headline value: uniform per-receiver Bernoulli ingress "
+                    "loss at rate p (loss_frac = p, the r17 finer grid); "
+                    "decimation rows kept for continuity: accept iff "
+                    "step % (d+1) == 0, loss_frac = d/(d+1)"
                 ),
                 "sweep": rows,
                 "by_delay": {f"d{r['delay']}": r for r in rows},
+                "bernoulli_sweep": bern_rows,
+                "by_loss": {
+                    f"p{r['p']}": r for r in bern_rows
+                },
                 "coded_serving": coded_serving,
             }
         ),
@@ -1623,6 +1674,44 @@ def child_main() -> None:
     log(f"device ed25519 layout A/B @ batch {ab_pad}: "
         f"row-major {rate_rm:.1f} vs batch-major "
         f"{device_curve[str(ab_pad)]:.1f} sigs/s")
+    # Ladder A/B at the same batch (r17): the 1-bit Straus scan vs the
+    # windowed joint-table ladder at the measured per-backend default
+    # window, both batch-major, best-of-3 steady state, verdict-checked.
+    from go_libp2p_pubsub_tpu.ops.ed25519 import default_window
+
+    dv_st, dt_st, rate_st = device_verify_window(
+        envs, ab_pad, ladder="straus", reps=3)
+    dv_wd, dt_wd, rate_wd = device_verify_window(
+        envs, ab_pad, ladder="windowed", reps=3)
+    for name, dv in (("straus", dv_st), ("windowed", dv_wd)):
+        assert bool(np.all(np.asarray(dv) == expected)), (
+            f"{name}-ladder device verdicts disagree with native"
+        )
+    device_ladder_ab = {
+        "batch": ab_pad,
+        "straus_sigs_per_sec": round(rate_st, 1),
+        "windowed_sigs_per_sec": round(rate_wd, 1),
+        "window": default_window(),
+        "best_of": 3,
+    }
+    log(f"device ed25519 ladder A/B @ batch {ab_pad}: "
+        f"straus {rate_st:.1f} vs windowed(w={default_window()}) "
+        f"{rate_wd:.1f} sigs/s")
+    # Window-size sweep (r17): one steady-state rate per practical w.  The
+    # per-backend default_window() is re-derived from this row, not assumed
+    # — on CPU the 4^w joint-grid precompute is FLOP-bound and caps the
+    # sweet spot; on TPU it vectorizes and larger w should win.
+    device_window_sweep = {"batch": ab_pad, "rows": {}}
+    for w in (2, 3, 4):
+        dv_w, _, rate_w = device_verify_window(
+            envs, ab_pad, ladder="windowed", window=w, reps=2)
+        assert bool(np.all(np.asarray(dv_w) == expected)), (
+            f"windowed w={w} device verdicts disagree with native"
+        )
+        device_window_sweep["rows"][f"w{w}"] = round(rate_w, 1)
+    log("device ed25519 window sweep @ batch "
+        f"{ab_pad}: " + ", ".join(
+            f"{k}={v:.1f}" for k, v in device_window_sweep["rows"].items()))
 
     # Config (c) native rate: the batch native_verify_window already timed
     # (a second full sign+verify of 8192 would measure the same thing twice).
@@ -1784,6 +1873,8 @@ def child_main() -> None:
                 "ed25519_device_scaling": device_curve,
                 "ed25519_batch_knee": device_batch_knee,
                 "ed25519_layout_ab": device_layout_ab,
+                "ed25519_ladder_ab": device_ladder_ab,
+                "ed25519_window_sweep": device_window_sweep,
                 "ed25519_native_sigs_per_sec": round(native_sigs_per_sec, 1),
                 "treecast_10peer_deliveries_per_sec": round(tree_msgs_per_sec, 1),
                 "scoring_heartbeat_ms": scoring_ms,

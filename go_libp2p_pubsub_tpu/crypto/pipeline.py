@@ -103,9 +103,10 @@ def _verify_native(pks, msgs, sigs) -> np.ndarray:
 def _verify_device(pks, msgs, sigs) -> np.ndarray:
     from ..ops import ed25519 as dev
 
-    # batch_major=None defers to the per-backend default (limb-major
-    # [22, B] kernel; verdict-identical to the row-major one).
-    return dev.verify_batch(pks, msgs, sigs, batch_major=None)
+    # batch_major=None / ladder=None defer to the per-backend measured
+    # defaults (limb-major [22, B] kernel, windowed joint-table ladder at
+    # default_window() bits per step; all variants verdict-identical).
+    return dev.verify_batch(pks, msgs, sigs, batch_major=None, ladder=None)
 
 
 def _verify_python(pks, msgs, sigs) -> np.ndarray:

@@ -36,7 +36,14 @@ only on rounds where ``step % (d + 1) == 0``; off-round eager pushes and
 pend-fold transfers are LOST (not held), off-round fragments are lost too.
 The asymmetry against the mesh families' lossless ``gossip_delay`` hold is
 deliberate: this model answers "what if the link actually drops frames",
-which is the regime where coding pays.
+which is the regime where coding pays.  A second, finer knob rides the
+same gate (r17): ``ingress_loss_p[i] = p`` closes the receiver's round
+with independent per-round probability p (Bernoulli, its own PRNG chain
+separate from both the gossip and coded keys), so the loss axis is
+continuous — the decimation grid can only express d/(d+1) in {0, 1/2,
+2/3, 3/4, ...}, while the bench's crossover sweep needs points below
+1/2.  Both gates AND together; p = 0.0 is a value-level no-op, so the
+clean-fabric bit-identity guarantee is untouched.
 
 Serving plane: the model speaks the streaming engine's dialect —
 ``MultiTopicEvents`` schedules with ``t = 1`` (``delay`` rows set
@@ -85,6 +92,10 @@ class HybridState(NamedTuple):
     #                         crash-safe decode state the engine checkpoints
     ingress_loss: jax.Array  # i32[N] decimation period (0 = lossless)
     key_coded: jax.Array    # coded plane's PRNG (separate from gossip key)
+    ingress_loss_p: jax.Array  # f32[N] Bernoulli per-round drop prob (0 = off)
+    key_loss: jax.Array     # Bernoulli gate's PRNG (its own chain: neither
+    #                         the gossip nor the coded stream may depend on
+    #                         whether the fabric is lossy)
 
 
 class HybridGossipSub:
@@ -208,6 +219,8 @@ class HybridGossipSub:
             # A fold of the seed key, NOT a split of the gossip chain: the
             # gossip key stream must be untouched for bit-identity.
             key_coded=jax.random.fold_in(jax.random.PRNGKey(seed), 0xC0DE),
+            ingress_loss_p=jnp.zeros((n,), jnp.float32),
+            key_loss=jax.random.fold_in(jax.random.PRNGKey(seed), 0x1055),
         )
 
     def set_ingress_loss(self, st: HybridState, delay) -> HybridState:
@@ -217,6 +230,16 @@ class HybridGossipSub:
             jnp.asarray(delay, jnp.int32), (self.n,)
         )
         return st._replace(ingress_loss=d)
+
+    def set_ingress_loss_p(self, st: HybridState, p) -> HybridState:
+        """Host-side Bernoulli loss knob: every peer's round closes with
+        independent probability ``p`` (scalar or per-peer f32[N]) — the
+        continuous companion to :meth:`set_ingress_loss`'s d/(d+1) grid.
+        0.0 restores the lossless fabric (a value-level no-op)."""
+        if isinstance(p, (int, float)) and not 0.0 <= p < 1.0:
+            raise ValueError(f"ingress_loss_p must be in [0, 1), got {p}")
+        pv = jnp.broadcast_to(jnp.asarray(p, jnp.float32), (self.n,))
+        return st._replace(ingress_loss_p=pv)
 
     @functools.partial(jax.jit, static_argnums=0)
     def publish(
@@ -246,7 +269,13 @@ class HybridGossipSub:
         n, k, m, kg = self.n, self.k, self.m, self.gen_size
         # Per-receiver ingress decimation gate, the r11 RLNC convention:
         # rounds where the gate is closed LOSE all data-plane ingress.
-        accept = jnp.mod(g.step, st.ingress_loss + 1) == 0        # bool[N]
+        # The Bernoulli gate (r17) ANDs in on its own key chain, split
+        # unconditionally so the draw stream is independent of the loss
+        # values; uniform() lands in [0, 1), so p = 0.0 never closes it.
+        kl, kln = jax.random.split(st.key_loss)
+        accept = (jnp.mod(g.step, st.ingress_loss + 1) == 0) & (
+            jax.random.uniform(kl, (n,)) >= st.ingress_loss_p
+        )                                                         # bool[N]
 
         # Loss-estimator "expected" plane, computed BEFORE the round mutates
         # the state: while the message window carries live traffic, every
@@ -355,6 +384,7 @@ class HybridGossipSub:
             coded=est.coded,
             basis=basis2,
             key_coded=kcn,
+            key_loss=kln,
         )
         return nxt, per_msg
 

@@ -21,9 +21,14 @@ Representation — built for the TPU's int32 VPU lanes:
   tensor — XLA lowers it to a small matmul, which is exactly what the
   hardware wants; no gather/scatter in the hot loop.
 - Points are extended twisted-Edwards (X, Y, Z, T) with the complete addition
-  formula (valid for doubling and identity), so the 256-step Straus ladder
-  has **no data-dependent branches**: each step is double + add-from-table
-  with a vectorized 4-way select.  ``lax.scan`` keeps it one XLA program.
+  formula (valid for doubling and identity), so the ladders have **no
+  data-dependent branches**.  Two verdict-identical double-scalarmult scans
+  are available behind ``verify_batch(ladder=...)``: the 1-bit joint Straus
+  scan (256 steps x double + 4-way select-add) and the r17 **w-bit windowed
+  joint-table ladder** (ceil(256/w) steps x w dedicated doublings + one
+  fused 4^w-way select-add, with a host comb for [i]B and a batch-parallel
+  precompute plane for the joint grid).  ``lax.scan`` keeps each one XLA
+  program.
 
 Scalars (S and k) are public in verification, so variable-base bits arrive as
 plain [B,256] arrays — no constant-time requirement.
@@ -208,6 +213,23 @@ def pt_add(p: Point, q: Point) -> Point:
     return Point(fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h))
 
 
+def pt_dbl(p: Point) -> Point:
+    """Dedicated extended-coordinate doubling (dbl-2008-hwcd, a = -1): 4
+    squarings + 4 multiplications against the complete add's 9 muls.  Exact
+    for every on-curve input including the identity — the result differs
+    from ``pt_add(p, p)`` only by projective scale, which ``pt_eq`` absorbs.
+    Used by the windowed ladder, where doublings dominate the scan."""
+    a = fe_sq(p.x)
+    b = fe_sq(p.y)
+    zz = fe_sq(p.z)
+    c = fe_add(zz, zz)
+    g = fe_sub(b, a)                      # G = D + B with D = aA = -A
+    f = fe_sub(g, c)
+    h = fe_sub(fe_sub(jnp.zeros_like(a), a), b)
+    e = fe_sub(fe_sub(fe_sq(fe_add(p.x, p.y)), a), b)
+    return Point(fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h))
+
+
 def pt_neg(p: Point) -> Point:
     zero = jnp.zeros_like(p.x)
     return Point(fe_sub(zero, p.x), p.y, p.z, fe_sub(zero, p.t))
@@ -220,6 +242,14 @@ def pt_select(points: List[Point], idx: jax.Array) -> Point:
     return jax.tree.map(
         lambda s: jnp.einsum("kbl,bk->bl", s, sel), stack
     )
+
+
+def pt_select_stacked(stack: Point, idx: jax.Array) -> Point:
+    """Row-major lookup against a pre-stacked [n, B, LIMBS] table: one
+    one-hot contraction per coordinate (the windowed ladder's fused
+    select; table size is read off the stack)."""
+    sel = jax.nn.one_hot(idx, stack.x.shape[0], dtype=jnp.int32)  # [B, n]
+    return jax.tree.map(lambda s: jnp.einsum("kbl,bk->bl", s, sel), stack)
 
 
 def pt_eq(p: Point, q: Point) -> jax.Array:
@@ -421,16 +451,32 @@ def pt_add_bm(p: Point, q: Point) -> Point:
     )
 
 
+def pt_dbl_bm(p: Point) -> Point:
+    """Batch-major mirror of :func:`pt_dbl` (dbl-2008-hwcd, a = -1)."""
+    a = fe_sq_bm(p.x)
+    b = fe_sq_bm(p.y)
+    zz = fe_sq_bm(p.z)
+    c = fe_add_bm(zz, zz)
+    g = fe_sub_bm(b, a)
+    f = fe_sub_bm(g, c)
+    h = fe_sub_bm(fe_sub_bm(jnp.zeros_like(a), a), b)
+    e = fe_sub_bm(fe_sub_bm(fe_sq_bm(fe_add_bm(p.x, p.y)), a), b)
+    return Point(
+        fe_mul_bm(e, f), fe_mul_bm(g, h), fe_mul_bm(f, g), fe_mul_bm(e, h)
+    )
+
+
 def pt_neg_bm(p: Point) -> Point:
     zero = jnp.zeros_like(p.x)
     return Point(fe_sub_bm(zero, p.x), p.y, p.z, fe_sub_bm(zero, p.t))
 
 
 def pt_select_stacked_bm(stack: Point, idx: jax.Array) -> Point:
-    """Table lookup against a PRE-stacked [4, LIMBS, B] table: the stack is
+    """Table lookup against a PRE-stacked [n, LIMBS, B] table: the stack is
     built once outside the ladder scan (the hoist), each step pays only the
-    one-hot contraction."""
-    sel = jax.nn.one_hot(idx, 4, dtype=jnp.int32)  # [B, 4]
+    one-hot contraction.  n = 4 for the Straus joint table, 4^w for the
+    windowed joint table — the size is read off the stack."""
+    sel = jax.nn.one_hot(idx, stack.x.shape[0], dtype=jnp.int32)  # [B, n]
     return jax.tree.map(
         lambda s: jnp.einsum("klb,bk->lb", s, sel), stack
     )
@@ -499,6 +545,174 @@ def straus_double_scalarmult_bm(
 
 
 # ---------------------------------------------------------------------------
+# windowed joint-table ladder (r17): w bits per step instead of 1
+# ---------------------------------------------------------------------------
+#
+# The Straus scan above retires ONE bit of each scalar per step: 256 steps ×
+# (1 double + 1 table add) = 512 serial point ops.  The windowed ladder
+# retires w bits per step from a joint table T[j*2^w + i] = [i]B + [j](-A):
+# ceil(256/w) steps × (w doublings + 1 fused table-select-add).  Serial
+# additions drop 256 -> ceil(256/w) (4x at w=4) and doublings move to the
+# dedicated 8-mul ``pt_dbl`` formula, so total serial point-op depth falls
+# ~35-40%.  The precompute plane:
+#
+# - the [i]B side is a host-side constant comb (exact big-int arithmetic via
+#   the Python oracle, cached per w) — zero device cost;
+# - the [j](-A) side is the only serial device precompute: a chain of
+#   2^w - 2 complete adds, batch-parallel;
+# - the joint (i, j) grid is ONE broadcast complete-add over all 4^w pairs —
+#   depth 1, but it is real work per table entry, which is why the best
+#   window is backend-dependent: on CPU (FLOP-bound) the grid bill caps the
+#   sweet spot at w=2; on TPU the grid vectorizes across lanes and w=4's
+#   shorter scan should win (``default_window``).
+#
+# Scalars are public in verification, so a plain (unsigned, non-NAF) window
+# decomposition is fine — no constant-time requirement, no data-dependent
+# branches: every step is w doublings plus one one-hot select-add, and the
+# identity entry at (0, 0) absorbs all-zero windows via the complete
+# formula.  Same exact integer arithmetic as Straus — verdict-identical
+# (asserted over RFC 8032 vectors, the corruption oracle, and a random
+# batch in ``tests/test_ed25519.py``).
+
+
+@functools.lru_cache(maxsize=None)
+def _base_window_consts(w: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Host comb for the fixed base: affine [i]B for i in [0, 2^w) as
+    (x, y, t) limb arrays of shape [2^w, LIMBS] (z = 1 everywhere; the
+    identity lands at i = 0 as (0, 1, 0)).  Exact big-int arithmetic via
+    the Python oracle; cached per window size, so the cost is paid once
+    per process, not per batch."""
+    from ..crypto import ed25519_ref as _ref
+
+    xs, ys, ts = [], [], []
+    for i in range(1 << w):
+        gx, gy, gz, _ = _ref.point_mul(i, _ref.BASE)
+        zinv = pow(gz, _P_INT - 2, _P_INT)
+        ax, ay = gx * zinv % _P_INT, gy * zinv % _P_INT
+        xs.append(_int_to_limbs(ax))
+        ys.append(_int_to_limbs(ay))
+        ts.append(_int_to_limbs(ax * ay % _P_INT))
+    return np.stack(xs), np.stack(ys), np.stack(ts)
+
+
+def _scalar_windows(bits: jax.Array, w: int) -> jax.Array:
+    """[..., 256] little-endian bits -> [..., ceil(256/w)] w-bit window
+    values (little-endian window order; zero-padded above bit 255 when
+    w does not divide 256)."""
+    nbits = bits.shape[-1]
+    nw = -(-nbits // w)
+    pad = nw * w - nbits
+    if pad:
+        bits = jnp.concatenate(
+            [bits, jnp.zeros(bits.shape[:-1] + (pad,), bits.dtype)], axis=-1
+        )
+    weights = jnp.asarray([1 << i for i in range(w)], jnp.int32)
+    return jnp.einsum(
+        "...nw,w->...n", bits.reshape(bits.shape[:-1] + (nw, w)), weights
+    )
+
+
+def _joint_table(neg_a: Point, window: int) -> Point:
+    """Row-major joint table: stacked [4^w, B, LIMBS] with
+    T[j*2^w + i] = [i]B + [j](-A)."""
+    n = 1 << window
+    b_shape = neg_a.x.shape[:-1]
+    chain = [pt_identity(b_shape), neg_a]
+    for _ in range(n - 2):
+        chain.append(pt_add(chain[-1], neg_a))
+    a_stack = jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *chain)
+    bx, by, bt = _base_window_consts(window)
+    b_pt = Point(
+        jnp.asarray(bx),
+        jnp.asarray(by),
+        jnp.zeros((n, LIMBS), jnp.int32).at[:, 0].set(1),
+        jnp.asarray(bt),
+    )
+    extra = (1,) * len(b_shape)
+    a_e = jax.tree.map(lambda v: v[:, None], a_stack)  # [2^w(j), 1(i), B, L]
+    b_e = jax.tree.map(
+        lambda v: v.reshape((1, n) + extra + (LIMBS,)), b_pt
+    )
+    grid = pt_add(a_e, b_e)  # one broadcast add over the whole (j, i) grid
+    return jax.tree.map(
+        lambda v: v.reshape((n * n,) + b_shape + (LIMBS,)), grid
+    )
+
+
+def _joint_table_bm(neg_a: Point, window: int) -> Point:
+    """Batch-major joint table: stacked [4^w, LIMBS, B], same indexing as
+    :func:`_joint_table`.  The (j, i) grid is flattened into the batch axis
+    so the one broadcast add stays in the native [LIMBS, B'] layout."""
+    n = 1 << window
+    bsz = neg_a.x.shape[1]
+    chain = [pt_identity_bm(bsz), neg_a]
+    for _ in range(n - 2):
+        chain.append(pt_add_bm(chain[-1], neg_a))
+    a_stack = jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *chain)
+    bx, by, bt = _base_window_consts(window)
+    ones = np.zeros((n, LIMBS), np.int32)
+    ones[:, 0] = 1
+    a_flat = jax.tree.map(
+        lambda v: jnp.broadcast_to(
+            v[:, None], (n, n, LIMBS, bsz)
+        ).transpose(2, 0, 1, 3).reshape(LIMBS, n * n * bsz),
+        a_stack,
+    )
+    b_flat = Point(*[
+        jnp.broadcast_to(
+            jnp.asarray(arr.T)[:, None, :, None], (LIMBS, n, n, bsz)
+        ).reshape(LIMBS, n * n * bsz)
+        for arr in (bx, by, ones, bt)
+    ])
+    grid = pt_add_bm(a_flat, b_flat)
+    return jax.tree.map(
+        lambda v: v.reshape(LIMBS, n * n, bsz).transpose(1, 0, 2), grid
+    )
+
+
+def windowed_double_scalarmult(
+    s_bits: jax.Array, k_bits: jax.Array, neg_a: Point, window: int = 4
+) -> Point:
+    """R' = [s]B + [k](-A) via the w-bit joint table: ceil(256/w) steps of
+    w dedicated doublings + 1 fused table-select-add (MSB-first windows)."""
+    w = window
+    table = _joint_table(neg_a, w)
+    sw = jnp.moveaxis(jnp.flip(_scalar_windows(s_bits, w), axis=-1), -1, 0)
+    kw = jnp.moveaxis(jnp.flip(_scalar_windows(k_bits, w), axis=-1), -1, 0)
+
+    def body(q, wins):
+        swi, kwi = wins
+        for _ in range(w):
+            q = pt_dbl(q)
+        q = pt_add(q, pt_select_stacked(table, swi + (kwi << w)))
+        return q, None
+
+    q, _ = jax.lax.scan(body, pt_identity(s_bits.shape[:-1]), (sw, kw))
+    return q
+
+
+def windowed_double_scalarmult_bm(
+    s_bits: jax.Array, k_bits: jax.Array, neg_a: Point, window: int = 4
+) -> Point:
+    """Batch-major windowed ladder: bits stay [B, 256] (host layout),
+    points are [LIMBS, B], the 4^w joint table is stacked once up front."""
+    w = window
+    table = _joint_table_bm(neg_a, w)
+    sw = jnp.moveaxis(jnp.flip(_scalar_windows(s_bits, w), axis=-1), -1, 0)
+    kw = jnp.moveaxis(jnp.flip(_scalar_windows(k_bits, w), axis=-1), -1, 0)
+
+    def body(q, wins):
+        swi, kwi = wins
+        for _ in range(w):
+            q = pt_dbl_bm(q)
+        q = pt_add_bm(q, pt_select_stacked_bm(table, swi + (kwi << w)))
+        return q, None
+
+    q, _ = jax.lax.scan(body, pt_identity_bm(s_bits.shape[0]), (sw, kw))
+    return q
+
+
+# ---------------------------------------------------------------------------
 # the jitted batch kernel
 # ---------------------------------------------------------------------------
 
@@ -544,6 +758,49 @@ def _verify_kernel_bm(
     return a_ok & r_ok & pt_eq_bm(r_prime, r_pt)
 
 
+@functools.partial(jax.jit, static_argnames=("window",))
+def _verify_kernel_windowed(
+    a_y: jax.Array,
+    a_sign: jax.Array,
+    r_y: jax.Array,
+    r_sign: jax.Array,
+    s_bits: jax.Array,
+    k_bits: jax.Array,
+    window: int = 4,
+) -> jax.Array:
+    """Row-major verify through the windowed joint-table ladder; same
+    inputs and verdicts as ``_verify_kernel``."""
+    a_pt, a_ok = pt_decompress(a_y, a_sign)
+    r_pt, r_ok = pt_decompress(r_y, r_sign)
+    r_prime = windowed_double_scalarmult(s_bits, k_bits, pt_neg(a_pt), window)
+    return a_ok & r_ok & pt_eq(r_prime, r_pt)
+
+
+@functools.partial(jax.jit, static_argnames=("window",))
+def _verify_kernel_windowed_bm(
+    a_y: jax.Array,
+    a_sign: jax.Array,
+    r_y: jax.Array,
+    r_sign: jax.Array,
+    s_bits: jax.Array,
+    k_bits: jax.Array,
+    window: int = 4,
+) -> jax.Array:
+    """Batch-major verify through the windowed ladder: fused A||R
+    decompression (as ``_verify_kernel_bm``) + the 4^w joint table."""
+    bsz = a_y.shape[0]
+    ys = jnp.concatenate([a_y.T, r_y.T], axis=1)        # [22, 2B]
+    signs = jnp.concatenate([a_sign, r_sign], axis=0)   # [2B]
+    pt, valid = pt_decompress_bm(ys, signs)
+    a_pt = jax.tree.map(lambda v: v[:, :bsz], pt)
+    r_pt = jax.tree.map(lambda v: v[:, bsz:], pt)
+    a_ok, r_ok = valid[:bsz], valid[bsz:]
+    r_prime = windowed_double_scalarmult_bm(
+        s_bits, k_bits, pt_neg_bm(a_pt), window
+    )
+    return a_ok & r_ok & pt_eq_bm(r_prime, r_pt)
+
+
 # ---------------------------------------------------------------------------
 # host wrapper
 # ---------------------------------------------------------------------------
@@ -575,21 +832,49 @@ def default_batch_major() -> bool:
     return True
 
 
+def default_ladder() -> str:
+    """Backend default for the double-scalarmult ladder (r17, measured —
+    see PERF.md): the windowed joint-table ladder replaces the 1-bit
+    Straus scan on every backend.  On the CPU fallback it measures well
+    past the 10% bar at batch 512 (fewer serial adds AND fewer total
+    muls once doublings use the dedicated 8-mul formula); on TPU the
+    serial-depth cut is the point and the 4^w-entry grid precompute
+    vectorizes across the lane axis."""
+    return "windowed"
+
+
+def default_window() -> int:
+    """Measured per-backend window size for ``ladder="windowed"`` (see the
+    ``ed25519_window_sweep`` bench row).  On CPU the joint-grid precompute
+    is FLOP-bound — 4^w complete adds of real work — which caps the sweet
+    spot at w=2 (measured best-of-20 at batch 64 AND 512: w2 −24/−27%
+    wall vs Straus, w3 a wash, w4 a loss); accelerators build the grid at
+    depth ~1 across lanes, so the shorter 64-step scan of w=4 should win
+    there — a stated TPU bet, re-decided by the first on-chip sweep."""
+    return 2 if jax.default_backend() == "cpu" else 4
+
+
 def verify_batch(
     pks: Sequence[bytes],
     msgs: Sequence[bytes],
     sigs: Sequence[bytes],
     pad_to: int | None = None,
     batch_major: bool | None = None,
+    ladder: str | None = None,
+    window: int | None = None,
 ) -> np.ndarray:
     """Device-batched verify of n (pk, msg, sig) triples -> bool[n].
 
     Hashing + canonicity pre-checks (S < L, y < p — byte-level, branchy)
-    run on host; decompression, the 256-step ladder, and the projective
-    compare run in one jitted device program.  ``pad_to`` rounds the batch
-    up (power-of-two padding avoids one recompile per batch size).
+    run on host; decompression, the ladder, and the projective compare run
+    in one jitted device program.  ``pad_to`` rounds the batch up
+    (power-of-two padding avoids one recompile per batch size).
     ``batch_major`` selects the limb-major [22, B] kernel (verdict-identical
     to the row-major one); ``None`` takes :func:`default_batch_major`.
+    ``ladder`` selects the scan: ``"straus"`` (1-bit joint table) or
+    ``"windowed"`` (w-bit joint table, ``window`` bits per step, w = None
+    -> :func:`default_window`); ``None`` takes :func:`default_ladder`.
+    All four kernel variants are verdict-identical.
     """
     n = len(pks)
     if not (n == len(msgs) == len(sigs)):
@@ -629,8 +914,13 @@ def verify_batch(
     r_y, r_sign = _enc_to_limbs_and_sign(r_rows)
     if batch_major is None:
         batch_major = default_batch_major()
-    kernel = _verify_kernel_bm if batch_major else _verify_kernel
-    ok = kernel(
+    if ladder is None:
+        ladder = default_ladder()
+    if ladder not in ("straus", "windowed"):
+        raise ValueError(f"unknown ladder {ladder!r}")
+    if window is not None and ladder != "windowed":
+        raise ValueError("window only applies to ladder='windowed'")
+    args = (
         jnp.asarray(pad(a_y)),
         jnp.asarray(pad(a_sign)),
         jnp.asarray(pad(r_y)),
@@ -638,4 +928,16 @@ def verify_batch(
         jnp.asarray(pad(_bytes_to_bits256(s_rows))),
         jnp.asarray(pad(_bytes_to_bits256(k_rows))),
     )
+    if ladder == "windowed":
+        w = default_window() if window is None else window
+        if not 1 <= w <= 6:
+            raise ValueError(f"window {w} outside the practical range [1, 6]")
+        kernel = (
+            _verify_kernel_windowed_bm if batch_major else
+            _verify_kernel_windowed
+        )
+        ok = kernel(*args, window=w)
+    else:
+        kernel = _verify_kernel_bm if batch_major else _verify_kernel
+        ok = kernel(*args)
     return np.asarray(jax.device_get(ok))[:n] & host_ok

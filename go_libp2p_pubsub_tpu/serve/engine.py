@@ -38,6 +38,7 @@ exactly-once across a crash even when producers resubmit at-least-once.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import hashlib
 import time
@@ -45,6 +46,24 @@ from typing import Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
+
+try:
+    # Dynamic, thread-local override of the persistent-compile-cache floor,
+    # read by jax's _cache_write per compilation (verified on 0.4.37).
+    from jax._src.config import (
+        persistent_cache_min_compile_time_secs as _persistent_cache_floor,
+    )
+except ImportError:  # pragma: no cover - jax moved the State: global flip
+
+    @contextlib.contextmanager
+    def _persistent_cache_floor(value):
+        old = jax.config.jax_persistent_cache_min_compile_time_secs
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", value)
+        try:
+            yield
+        finally:
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", old)
 
 from ..models.multitopic import MultiTopicGossipSub
 from ..ops import schedule as sched
@@ -444,7 +463,14 @@ class StreamingEngine:
         return slot
 
     def _dispatch(self, events: sched.MultiTopicEvents, n_items: int = 0):
-        self.state, record = self._rollout(self.state, events)
+        # Chunk executables must NEVER enter the persistent compile cache:
+        # the CPU backend segfaults executing a DESERIALIZED donated-state
+        # chunk program (see tests/conftest.py).  The repo-wide 10 s floor
+        # only keeps them out while compiles stay fast — on a loaded box a
+        # chunk compile crosses the floor and poisons the cache for every
+        # later process.  Opt out at the one site that compiles them.
+        with _persistent_cache_floor(float("inf")):
+            self.state, record = self._rollout(self.state, events)
         digest = jax.device_get(self.model.stream_digest(self.state))
         t_done = self._clock()
         self.chunks_run += 1

@@ -215,11 +215,9 @@ _RFC8032 = [
 ]
 
 
-def test_device_rfc8032_vectors_both_layouts():
-    """RFC 8032 §7.1 vectors accept (and corrupted variants reject) under
-    BOTH kernel layouts, with identical verdict vectors."""
-    from go_libp2p_pubsub_tpu.ops import ed25519 as dev
-
+def _rfc8032_batch():
+    """The four §7.1 vectors plus two corrupted rows (flipped sig bit,
+    flipped pubkey bit) -> (pks, msgs, sigs, want)."""
     pks, msgs, sigs = [], [], []
     for sk_h, pk_h, msg_h, sig_h in _RFC8032:
         sk, pk = bytes.fromhex(sk_h), bytes.fromhex(pk_h)
@@ -228,15 +226,21 @@ def test_device_rfc8032_vectors_both_layouts():
         pks.append(pk)
         msgs.append(msg)
         sigs.append(sig)
-    # two corrupted rows ride along: flipped sig bit, flipped pubkey bit
     pks.append(pks[0])
     msgs.append(msgs[0])
     sigs.append(bytes([sigs[0][0] ^ 1]) + sigs[0][1:])
     pks.append(bytes([pks[1][0] ^ 1]) + pks[1][1:])
     msgs.append(msgs[1])
     sigs.append(sigs[1])
+    return pks, msgs, sigs, np.array([True] * 4 + [False] * 2)
 
-    want = np.array([True] * 4 + [False] * 2)
+
+def test_device_rfc8032_vectors_both_layouts():
+    """RFC 8032 §7.1 vectors accept (and corrupted variants reject) under
+    BOTH kernel layouts, with identical verdict vectors."""
+    from go_libp2p_pubsub_tpu.ops import ed25519 as dev
+
+    pks, msgs, sigs, want = _rfc8032_batch()
     rm = dev.verify_batch(pks, msgs, sigs, pad_to=8, batch_major=False)
     bm = dev.verify_batch(pks, msgs, sigs, pad_to=8, batch_major=True)
     np.testing.assert_array_equal(rm, want)
@@ -247,7 +251,9 @@ def test_device_rfc8032_vectors_both_layouts():
 def test_device_batch_major_bit_exact_sweep():
     """256-signature sweep (valid / corrupt sig / corrupt msg / corrupt pk /
     malleable S / non-canonical R mix): the batch-major kernel's verdict
-    vector is bit-identical to the row-major kernel's and to the oracle."""
+    vector is bit-identical to the row-major kernel's and to the oracle —
+    and the windowed ladder (r17) matches in BOTH layouts on the same
+    sweep."""
     from go_libp2p_pubsub_tpu.ops import ed25519 as dev
 
     rng = np.random.default_rng(20260805)
@@ -278,6 +284,183 @@ def test_device_batch_major_bit_exact_sweep():
     np.testing.assert_array_equal(rm, oracle)
     np.testing.assert_array_equal(bm, rm)
     assert oracle.any() and not oracle.all()
+    wrm = dev.verify_batch(
+        pks, msgs, sigs, batch_major=False, ladder="windowed"
+    )
+    wbm = dev.verify_batch(pks, msgs, sigs, batch_major=True, ladder="windowed")
+    np.testing.assert_array_equal(wrm, oracle)
+    np.testing.assert_array_equal(wbm, oracle)
+
+
+# ---------------------------------------------------------------------------
+# windowed joint-table ladder (r17) vs Straus
+# ---------------------------------------------------------------------------
+
+
+def test_scalar_windows_reassemble():
+    """w-bit window decomposition round-trips: reassembling the windows in
+    little-endian window order recovers the scalar, for every w in range."""
+    import jax.numpy as jnp
+
+    from go_libp2p_pubsub_tpu.ops import ed25519 as dev
+
+    rng = np.random.default_rng(17)
+    raw = rng.bytes(32)
+    value = int.from_bytes(raw, "little")
+    bits = np.unpackbits(
+        np.frombuffer(raw, np.uint8), bitorder="little"
+    ).astype(np.int32)
+    for w in range(1, 7):
+        wins = np.asarray(dev._scalar_windows(jnp.asarray(bits), w))
+        assert wins.shape == (-(-256 // w),)
+        assert (wins < (1 << w)).all()
+        assert sum(int(v) << (w * i) for i, v in enumerate(wins)) == value
+
+
+def test_pt_dbl_matches_pt_add_both_layouts():
+    """The dedicated 8-mul doubling formula agrees (projectively) with the
+    complete addition pt_add(p, p) on random points AND the identity."""
+    import jax.numpy as jnp
+
+    from go_libp2p_pubsub_tpu.ops import ed25519 as dev
+
+    rng = np.random.default_rng(8)
+    xs, ys, ts = [], [], []
+    for k in [0, 1] + [int.from_bytes(rng.bytes(32), "little") for _ in range(4)]:
+        gx, gy, gz, _ = ref.point_mul(k, ref.BASE)
+        zinv = pow(gz, ref.P - 2, ref.P)
+        ax, ay = gx * zinv % ref.P, gy * zinv % ref.P
+        xs.append(dev._int_to_limbs(ax))
+        ys.append(dev._int_to_limbs(ay))
+        ts.append(dev._int_to_limbs(ax * ay % ref.P))
+    z = np.zeros((len(xs), dev.LIMBS), np.int32)
+    z[:, 0] = 1
+    p = dev.Point(
+        jnp.asarray(np.stack(xs)), jnp.asarray(np.stack(ys)),
+        jnp.asarray(z), jnp.asarray(np.stack(ts)),
+    )
+    assert np.asarray(dev.pt_eq(dev.pt_dbl(p), dev.pt_add(p, p))).all()
+    p_bm = dev.Point(*[jnp.asarray(np.asarray(v).T) for v in p])
+    assert np.asarray(
+        dev.pt_eq_bm(dev.pt_dbl_bm(p_bm), dev.pt_add_bm(p_bm, p_bm))
+    ).all()
+
+
+def test_device_rfc8032_vectors_windowed_both_layouts():
+    """RFC 8032 §7.1 vectors (+ corrupted rows) through the windowed ladder
+    in both layouts: verdicts identical to the expected vector (and hence to
+    the Straus kernels, pinned by the layout test above)."""
+    from go_libp2p_pubsub_tpu.ops import ed25519 as dev
+
+    pks, msgs, sigs, want = _rfc8032_batch()
+    rm = dev.verify_batch(
+        pks, msgs, sigs, pad_to=8, batch_major=False, ladder="windowed"
+    )
+    bm = dev.verify_batch(
+        pks, msgs, sigs, pad_to=8, batch_major=True, ladder="windowed"
+    )
+    np.testing.assert_array_equal(rm, want)
+    np.testing.assert_array_equal(bm, want)
+
+
+def test_verify_batch_ladder_flag_validation():
+    """Bad ladder/window combinations fail loudly, before any device work."""
+    from go_libp2p_pubsub_tpu.ops import ed25519 as dev
+
+    pks, msgs, sigs, _ = _rfc8032_batch()
+    one = (pks[:1], msgs[:1], sigs[:1])
+    with pytest.raises(ValueError, match="unknown ladder"):
+        dev.verify_batch(*one, ladder="montgomery")
+    with pytest.raises(ValueError, match="window only applies"):
+        dev.verify_batch(*one, ladder="straus", window=3)
+    with pytest.raises(ValueError, match="outside the practical range"):
+        dev.verify_batch(*one, ladder="windowed", window=0)
+    with pytest.raises(ValueError, match="outside the practical range"):
+        dev.verify_batch(*one, ladder="windowed", window=7)
+    assert dev.default_ladder() in ("straus", "windowed")
+    assert 1 <= dev.default_window() <= 6
+
+
+@pytest.mark.slow
+def test_windowed_vs_straus_bit_identity_sweep():
+    """Random 64-signature batch (1 in 4 corrupted): windowed verdicts are
+    bit-identical to Straus for every window size in the bench sweep, in
+    both layouts."""
+    from go_libp2p_pubsub_tpu.ops import ed25519 as dev
+
+    rng = np.random.default_rng(64)
+    _, msgs, pks, sigs = _rand_batch(64, seed=4242)
+    sigs = list(sigs)
+    for i in range(0, 64, 4):
+        b = bytearray(sigs[i])
+        b[rng.integers(0, 64)] ^= 1 << rng.integers(0, 8)
+        sigs[i] = bytes(b)
+    straus = dev.verify_batch(pks, msgs, sigs, batch_major=False,
+                              ladder="straus")
+    assert straus.any() and not straus.all()
+    for w in (2, 3, 4):
+        for bm in (False, True):
+            got = dev.verify_batch(
+                pks, msgs, sigs, batch_major=bm, ladder="windowed", window=w
+            )
+            np.testing.assert_array_equal(got, straus)
+
+
+@pytest.mark.slow
+def test_joint_table_exhaustive_vs_oracle():
+    """Every entry of the device joint table T[j*2^w + i] = [i]B + [j](-A)
+    equals the big-int oracle's point, exhaustively for w in {2, 3}, in both
+    layouts (64 + 16 entries; affine compare + T = XY/Z consistency)."""
+    import jax.numpy as jnp
+
+    from go_libp2p_pubsub_tpu.ops import ed25519 as dev
+
+    def limbs_to_int(row):
+        return sum(int(v) << (dev.BITS * i) for i, v in enumerate(row))
+
+    seed = b"\x2a" * 32
+    pk = ref.public_key(seed)
+    a_ext = ref.point_decompress(pk)
+    neg_a_ext = ((ref.P - a_ext[0]) % ref.P, a_ext[1], a_ext[2],
+                 (ref.P - a_ext[3]) % ref.P)
+
+    y_limbs, sign = dev._enc_to_limbs_and_sign(
+        np.frombuffer(pk, np.uint8).reshape(1, 32)
+    )
+    a_pt, a_ok = dev.pt_decompress(jnp.asarray(y_limbs), jnp.asarray(sign))
+    assert bool(np.asarray(a_ok)[0])
+    a_bm = dev.Point(*[jnp.asarray(np.asarray(v).T) for v in a_pt])
+
+    for w in (2, 3):
+        n = 1 << w
+        table = dev._joint_table(dev.pt_neg(a_pt), w)
+        tx = np.asarray(dev.fe_canon(table.x[:, 0]))
+        ty = np.asarray(dev.fe_canon(table.y[:, 0]))
+        tz = np.asarray(dev.fe_canon(table.z[:, 0]))
+        tt = np.asarray(dev.fe_canon(table.t[:, 0]))
+        table_bm = dev._joint_table_bm(dev.pt_neg_bm(a_bm), w)
+        for j in range(n):
+            for i in range(n):
+                want = ref.point_add(
+                    ref.point_mul(i, ref.BASE), ref.point_mul(j, neg_a_ext)
+                )
+                zinv = pow(want[2], ref.P - 2, ref.P)
+                wx, wy = want[0] * zinv % ref.P, want[1] * zinv % ref.P
+                k = j * n + i
+                gx, gy = limbs_to_int(tx[k]), limbs_to_int(ty[k])
+                gz, gt = limbs_to_int(tz[k]), limbs_to_int(tt[k])
+                ziv = pow(gz, ref.P - 2, ref.P)
+                assert gx * ziv % ref.P == wx and gy * ziv % ref.P == wy
+                # extended-coordinate invariant the later adds rely on
+                assert gt * gz % ref.P == gx * gy % ref.P
+                # batch-major table builds the same projective point
+                eq = dev.pt_eq_bm(
+                    dev.Point(*[
+                        jnp.asarray(np.asarray(v)[k].T) for v in table
+                    ]),
+                    dev.Point(*[v[k] for v in table_bm]),
+                )
+                assert bool(np.asarray(eq)[0])
 
 
 # ---------------------------------------------------------------------------

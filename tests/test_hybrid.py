@@ -98,6 +98,32 @@ def test_hysteresis_band_prevents_flapping():
     )[0, 0])
 
 
+def test_set_ingress_loss_p_validates_and_broadcasts():
+    """The Bernoulli loss knob (r17): out-of-range probabilities fail
+    loudly at set time; in-range scalars broadcast to a per-peer f32[N]
+    leaf; p=0 is the init value (value-level no-op, guarded by the
+    clean-fabric bit-identity test)."""
+    import jax.numpy as jnp
+
+    from go_libp2p_pubsub_tpu.models.hybrid import HybridGossipSub
+
+    hy = HybridGossipSub(**_TINY)
+    st = hy.init(seed=0)
+    assert st.ingress_loss_p.shape == (hy.n,)
+    assert float(jnp.max(st.ingress_loss_p)) == 0.0
+
+    st2 = hy.set_ingress_loss_p(st, 0.25)
+    assert st2.ingress_loss_p.dtype == jnp.float32
+    assert np.allclose(np.asarray(st2.ingress_loss_p), 0.25)
+    # Decimation knob untouched: the two loss models compose.
+    assert np.array_equal(np.asarray(st2.ingress_loss),
+                          np.asarray(st.ingress_loss))
+
+    for bad in (-0.1, 1.0, 1.5):
+        with pytest.raises(ValueError):
+            hy.set_ingress_loss_p(st, bad)
+
+
 # ---------------------------------------------------------------------------
 # scenario-plane validation (tier 1: pure host, no device work)
 # ---------------------------------------------------------------------------
@@ -243,6 +269,31 @@ def test_adaptive_switches_and_delivers_under_decimation():
     assert a_frac > e_frac + 0.5, \
         f"adaptive ({a_frac}) should dominate forced eager ({e_frac})"
     assert np.isfinite(a_p99)
+
+
+@pytest.mark.slow
+def test_adaptive_switches_under_bernoulli_loss():
+    """Same contract as the decimation test on the r17 Bernoulli loss
+    model: at p=0.5 the EWMA converges near the true rate, edges flip to
+    the coded plane, and the message still delivers."""
+    from go_libp2p_pubsub_tpu.models.hybrid import HybridGossipSub
+
+    hy = HybridGossipSub(**_TINY)
+    st = _publish_all(hy, hy.init(seed=0))
+    st = hy.set_ingress_loss_p(st, 0.5)
+    out, rec = hy.rollout(st, 2 * _STEPS, record=True)
+    frac, _, _ = hy.delivery_stats(out)
+
+    assert int(np.asarray(rec["coded_edges"])[-1]) > 0, "no edge switched"
+    # Per-edge maxima are order-statistic noise at this mesh size; the
+    # MEAN over edges that saw traffic is the estimator's convergence
+    # statistic, and it must straddle the true rate.
+    ewma = np.asarray(out.loss_ewma)
+    mean_active = float(ewma[ewma > 0].mean())
+    assert 0.35 < mean_active < 0.65, \
+        f"active-edge EWMA mean {mean_active} not tracking Bernoulli p=0.5"
+    assert float(np.asarray(ewma).max()) > hy.switch_hi
+    assert float(np.nanmean(np.asarray(frac))) == 1.0
 
 
 @pytest.mark.slow

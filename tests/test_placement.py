@@ -204,4 +204,36 @@ def test_perf_diff_warns_on_missing_sharded_section(tmp_path):
     )
     assert r.returncode == 0, r.stderr
     assert "WARNING" in r.stdout and "sharded" in r.stdout
-    assert "sharded msgs/sec" in r.stdout
+
+
+def test_perf_diff_warns_on_pre_r17_record(tmp_path):
+    """A pre-r17 record (no ladder A/B, no window sweep, no Bernoulli loss
+    sweep) diffed against an r17 record warns per missing key and exits 0 —
+    standing perf history must stay comparable across the methodology
+    change."""
+    old = {"metric": "m", "value": 100.0, "methodology_version": 2,
+           "backend": "cpu", "n_peers": 4,
+           "hybrid": {"value": 0.4, "by_loss": {}}}
+    new = dict(
+        old,
+        hybrid={"value": 0.375, "crossover_decimation": 0.5,
+                "bernoulli_sweep": [], "by_loss": {}},
+        ed25519_ladder_ab={"batch": 512, "straus_sigs_per_sec": 100.0,
+                           "windowed_sigs_per_sec": 120.0, "window": 3,
+                           "best_of": 3},
+        ed25519_window_sweep={"batch": 512, "rows": {
+            "w2": 110.0, "w3": 120.0, "w4": 90.0}},
+    )
+    po, pn = tmp_path / "o.json", tmp_path / "n.json"
+    po.write_text(json.dumps(old))
+    pn.write_text(json.dumps(new))
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools", "perf_diff.py"),
+         str(po), str(pn)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "WARNING" in r.stdout
+    for key in ("ed25519_ladder_ab", "ed25519_window_sweep",
+                "bernoulli_sweep"):
+        assert key in r.stdout, f"no warning mentioning {key}"

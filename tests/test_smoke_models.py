@@ -123,6 +123,20 @@ def test_all_families_trace_smoke():
 
     assert jax.eval_shape(_bm_kernel).shape == (4,)
 
+    # windowed joint-table ladder (r17) flag rotation: both layouts trace at
+    # the bench-sweep window sizes (the jaxpr changes with w — table width,
+    # scan length — so each (layout, w) pair is a distinct program).
+    def _windowed(bm, w):
+        z2 = jnp.zeros((4, ed.LIMBS), jnp.int32)
+        z1 = jnp.zeros((4,), jnp.int32)
+        zb = jnp.zeros((4, 256), jnp.int32)
+        k = ed._verify_kernel_windowed_bm if bm else ed._verify_kernel_windowed
+        return k(z2, z1, z2, z1, zb, zb, window=w)
+
+    for w in (2, 3, 4):
+        assert jax.eval_shape(lambda: _windowed(False, w)).shape == (4,)
+        assert jax.eval_shape(lambda: _windowed(True, w)).shape == (4,)
+
     # -- treecast / floodsub (cheap anyway, but keep the tier complete) ----
     from go_libp2p_pubsub_tpu.config import SimParams, TreeOpts
     from go_libp2p_pubsub_tpu.models.floodsub import FloodSub

@@ -127,6 +127,17 @@ SCALAR_ROWS: List[Tuple[Tuple[str, ...], str, bool]] = [
     # and the coded_serving rows carry the two r16 canons' crash-recovery
     # and eager-comparison measurements.
     (("hybrid", "value"), "hybrid crossover loss frac", False),
+    # r17: the headline crossover moves to the finer Bernoulli grid;
+    # 'crossover_decimation' keeps the r16 d/(d+1) number for continuity,
+    # and the by_loss rows pin the Bernoulli interior points.
+    (("hybrid", "crossover_decimation"),
+     "hybrid decimation crossover loss frac", False),
+    (("hybrid", "by_loss", "p0.25", "adaptive", "delivery_frac"),
+     "hybrid p0.25 adaptive delivery frac", True),
+    (("hybrid", "by_loss", "p0.375", "adaptive", "p99_latency_rounds"),
+     "hybrid p0.375 adaptive p99 (rounds)", False),
+    (("hybrid", "by_loss", "p0.375", "eager_forced", "delivery_frac"),
+     "hybrid p0.375 eager delivery frac", True),
     (("hybrid", "by_delay", "d1", "adaptive", "delivery_frac"),
      "hybrid d1 adaptive delivery frac", True),
     (("hybrid", "by_delay", "d1", "adaptive", "p99_latency_rounds"),
@@ -157,6 +168,13 @@ SCALAR_ROWS: List[Tuple[Tuple[str, ...], str, bool]] = [
      "device ed25519 row-major sigs/s", True),
     (("ed25519_layout_ab", "batchmajor_sigs_per_sec"),
      "device ed25519 batch-major sigs/s", True),
+    # Windowed-ladder A/B (r17): straus vs windowed steady-state rates at
+    # the same batch; the per-window sweep rows are collected dynamically
+    # in collect_rows (window sizes may change between rounds).
+    (("ed25519_ladder_ab", "straus_sigs_per_sec"),
+     "device ed25519 straus sigs/s", True),
+    (("ed25519_ladder_ab", "windowed_sigs_per_sec"),
+     "device ed25519 windowed sigs/s", True),
     (("rlnc", "gf256_matmul", "table_ms"), "gf256 matmul table (ms)", False),
     (("rlnc", "gf256_matmul", "mxu_ms"), "gf256 matmul mxu (ms)", False),
     (("sharded", "rollout_memory", "temp_bytes"),
@@ -244,6 +262,17 @@ def collect_rows(old: Dict[str, Any], new: Dict[str, Any], threshold: float):
         delta, flag = classify(o, n, True, threshold)
         rows.append((f"device ed25519 @{b} (sigs/s)", fmt(o), fmt(n),
                      delta, flag))
+    # windowed-ladder size sweep (r17): per-window sigs/s, higher is better
+    def _window_rows(d):
+        s = d.get("ed25519_window_sweep")
+        return s.get("rows", {}) if isinstance(s, dict) else {}
+
+    for wkey in sorted(set(_window_rows(old)) | set(_window_rows(new))):
+        o = dig(old, ("ed25519_window_sweep", "rows", wkey))
+        n = dig(new, ("ed25519_window_sweep", "rows", wkey))
+        delta, flag = classify(o, n, True, threshold)
+        rows.append((f"device ed25519 windowed {wkey} (sigs/s)",
+                     fmt(o), fmt(n), delta, flag))
     # sharded per-phase split/monolithic times, lower is better
     def _sharded_phases(d):
         s = d.get("sharded")
@@ -381,6 +410,19 @@ def context_warnings(old: Dict[str, Any], new: Dict[str, Any]) -> List[str]:
                 f"{name} hybrid coded_serving canons errored: "
                 f"{str(s['coded_serving']['error'])[:120]}"
             )
+    # Bernoulli loss sweep (r17): a pre-r17 record's headline crossover sat
+    # on the coarse decimation grid, so the 'hybrid value' row compares two
+    # DIFFERENT grids — warn and point at the like-for-like row.
+    if (isinstance(ho, dict) and isinstance(hn, dict)
+            and ("bernoulli_sweep" in ho) != ("bernoulli_sweep" in hn)):
+        which = "old" if "bernoulli_sweep" not in ho else "new"
+        warns.append(
+            f"only one record has a hybrid 'bernoulli_sweep' (missing in "
+            f"{which}; added in r17) — the headline crossover rides a "
+            f"different loss grid per side (decimation d/(d+1) vs Bernoulli "
+            f"p); compare 'hybrid decimation crossover loss frac' for "
+            f"like-for-like"
+        )
     # Hardware-shape restructure keys (r15+): presence mismatch means one
     # record predates the batch-major/fused-prologue/MXU round — the
     # affected rows are one-sided, not a crash.
@@ -390,6 +432,16 @@ def context_warnings(old: Dict[str, Any], new: Dict[str, Any]) -> List[str]:
             warns.append(
                 f"only one record has '{key}' (missing in {which}; added "
                 f"in r15) — its rows are one-sided"
+            )
+    # Windowed-ladder keys (r17): pre-r17 records only ever ran the Straus
+    # scan, so the ladder A/B and window-sweep rows have nothing to pair
+    # against — one-sided, not a crash.
+    for key in ("ed25519_ladder_ab", "ed25519_window_sweep"):
+        if (key in old) != (key in new):
+            which = "old" if key not in old else "new"
+            warns.append(
+                f"only one record has '{key}' (missing in {which}; added "
+                f"in r17) — its rows are one-sided"
             )
     if (isinstance(ro, dict) and isinstance(rn, dict)
             and ("gf256_matmul" in ro) != ("gf256_matmul" in rn)):
