@@ -1552,6 +1552,112 @@ def streaming_child_main() -> None:
         "elapsed_s": round(degraded_elapsed, 3),
     }
 
+    # ---- obs: traced-vs-untraced A/B (r18 observability overhead) --------
+    # Fresh ring+engine pairs over the SAME model (shared compiled rollout,
+    # so neither arm compiles anything) run an identical constant workload;
+    # the traced arm carries the full telemetry plane (span ledger sampling
+    # every message, shared registry, black box).  Arms alternate and the
+    # headline is best-of-N per arm, so one-sided scheduler noise can't
+    # masquerade as tracing cost.
+    from go_libp2p_pubsub_tpu.obs import BlackBox, SpanLedger
+    from go_libp2p_pubsub_tpu.utils.metrics import MetricsRegistry
+
+    log("obs: traced vs untraced A/B (sample 1/1)")
+    n_obs_msgs = min(64, cfg["msg_window"] // 2)
+    obs_reps = 3
+
+    def obs_arm(traced, seed):
+        if traced:
+            oreg = MetricsRegistry()
+            oled = SpanLedger(sample_n=1)
+            obox = BlackBox(capacity=64)
+        else:
+            oreg = oled = obox = None
+        oring = IngestRing(capacity=cfg["capacity"], policy="block",
+                           metrics=oreg, tracer=oled)
+        oeng = StreamingEngine(
+            model, oring, chunk_steps=cfg["chunk_steps"],
+            pub_width=cfg["pub_width"],
+            completion_frac=cfg["completion_frac"], seed=seed,
+            metrics=oreg, tracer=oled, blackbox=obox,
+        )
+        oeng.warmup()
+        opipe = ValidationPipeline(
+            backend=crypto_backend, flush_threshold=1 << 20,
+            tracer=oled, metrics=oreg,
+            on_verdict_ctx=lambda env, ok, ctx: oring.push(
+                topic=ctx[0], payload=env.payload, publisher=ctx[1],
+                valid=ok, timeout=30.0,
+            ),
+        )
+        if traced:
+            # Warm the deliver digest's one-time jit (shared across arms
+            # via the model-keyed cache) outside the timed window.
+            jax.block_until_ready(
+                model.stream_deliver_steps(
+                    oeng.state, cfg["chunk_steps"], cfg["completion_frac"]))
+        orng = np.random.default_rng(7)
+        t0 = time.perf_counter()
+        for i0 in range(0, n_obs_msgs, P):
+            for i in range(i0, min(i0 + P, n_obs_msgs)):
+                oseed = orng.bytes(32)
+                env = sign_envelope(
+                    oseed, f"topic-{i % 2}", i, b"obs payload %d" % i,
+                    backend=crypto_backend,
+                )
+                opipe.submit(env, ctx=(i % 2, int(orng.integers(n_peers))))
+            opipe.flush()
+            oeng.run_chunk()
+        oeng.run_until_drained(max_chunks=64)
+        elapsed = time.perf_counter() - t0
+        return oeng, oled, oeng.completed * participants / elapsed
+
+    traced_rates, untraced_rates = [], []
+    obs_eng = obs_led = None
+    for rep in range(obs_reps):
+        _, _, r_plain = obs_arm(False, seed=100 + rep)
+        obs_eng, obs_led, r_traced = obs_arm(True, seed=200 + rep)
+        untraced_rates.append(r_plain)
+        traced_rates.append(r_traced)
+    best_plain = max(untraced_rates)
+    best_traced = max(traced_rates)
+    overhead = max(0.0, 1.0 - best_traced / best_plain)
+    q_chunk = obs_eng.latency_quantiles(mode="chunk")
+    q_exact = obs_eng.latency_quantiles(mode="exact")
+    osum = obs_led.summary()
+    log(f"obs: untraced {best_plain:,.0f} msgs/s  traced "
+        f"{best_traced:,.0f} msgs/s  overhead {overhead*100:.2f}%  "
+        f"spans {osum['spans']} (open {osum['open']})  "
+        f"exact p50 {q_exact['p50']*1e3:.1f}ms vs chunk "
+        f"{q_chunk['p50']*1e3:.1f}ms")
+    assert osum["open"] == 0, \
+        f"{osum['open']} spans left open after drain"
+    assert q_exact["p50"] <= q_chunk["p50"] + 1e-9, \
+        "span-exact p50 above chunk-quantized p50"
+    assert overhead <= 0.02, \
+        f"tracing overhead {overhead*100:.2f}% above the 2% budget"
+    assert engine.compile_cache_size() == 1, \
+        "obs A/B grew the resident rollout cache"
+    obs_section = {
+        "reps": obs_reps,
+        "msgs_per_rep": n_obs_msgs,
+        "sample_n": 1,
+        "untraced_msgs_per_sec": round(best_plain, 1),
+        "traced_msgs_per_sec": round(best_traced, 1),
+        "overhead_frac": round(overhead, 5),
+        "spans": osum["spans"],
+        "spans_open": osum["open"],
+        "chunk_p50_s": round(q_chunk["p50"], 6),
+        "chunk_p99_s": round(q_chunk["p99"], 6),
+        "span_p50_s": round(q_exact["p50"], 6),
+        "span_p99_s": round(q_exact["p99"], 6),
+        "note": (
+            "interleaved A/B over fresh ring+engine pairs sharing the one "
+            "compiled rollout; best-of-reps rates; span quantiles are the "
+            "ledger-fed exact ingest->delivery latencies"
+        ),
+    }
+
     cache = engine.compile_cache_size()
     record = {
         "metric": "streaming_validated_msgs_per_sec",
@@ -1582,6 +1688,7 @@ def streaming_child_main() -> None:
         "hot": sections["hot"],
         "faulted": faulted,
         "degraded": degraded,
+        "obs": obs_section,
     }
     assert record["compile"]["compiled_once"], \
         f"resident chunk recompiled (cache_size={cache})"

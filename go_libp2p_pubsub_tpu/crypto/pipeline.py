@@ -18,6 +18,7 @@ signature cannot be replayed across topics or sequence numbers.
 from __future__ import annotations
 
 import struct
+import time
 from dataclasses import dataclass
 from typing import Callable, List, Literal, Sequence, Tuple
 
@@ -139,6 +140,8 @@ class ValidationPipeline:
         flush_threshold: int = 256,
         on_verdict: Callable[[Envelope, bool], None] | None = None,
         on_verdict_ctx: Callable[[Envelope, bool, object], None] | None = None,
+        tracer=None,
+        metrics=None,
     ) -> None:
         if backend not in _BACKENDS:
             raise ValueError(f"unknown backend {backend!r}")
@@ -146,6 +149,13 @@ class ValidationPipeline:
         self.flush_threshold = flush_threshold
         self.on_verdict = on_verdict
         self.on_verdict_ctx = on_verdict_ctx
+        # r18 observability: an optional obs.SpanLedger stamps
+        # verify_submit/verify_verdict when ctx carries the streaming
+        # plane's (topic, src) routing tuple; an optional MetricsRegistry
+        # publishes verdict counters + batch verify wall time under
+        # ``crypto.pipeline.*`` — the one-registry telemetry plane.
+        self.tracer = tracer
+        self.metrics = metrics
         self._pending: List[Tuple[Envelope, object]] = []
         self.stats = {"validated": 0, "accepted": 0, "rejected": 0}
 
@@ -153,6 +163,13 @@ class ValidationPipeline:
         """Queue an envelope; ``ctx`` is opaque caller state (e.g. the
         streaming plane's routing tuple) handed back via ``on_verdict_ctx``
         so verdict delivery needs no side-channel lookup."""
+        if self.tracer is not None:
+            from ..obs.spans import envelope_span_key
+
+            key = envelope_span_key(env.payload, ctx)
+            if key is not None:
+                self.tracer.stamp(key, "verify_submit",
+                                  seqno=env.seqno, topic=env.topic)
         self._pending.append((env, ctx))
         if len(self._pending) >= self.flush_threshold:
             self.flush()
@@ -181,6 +198,7 @@ class ValidationPipeline:
             len(e.pubkey) == 32 and len(e.signature) == 64 for e in batch
         ]
         good = [e for e, w in zip(batch, well_formed) if w]
+        t_v0 = time.monotonic()
         try:
             verdicts = (
                 _BACKENDS[self.backend](
@@ -197,6 +215,7 @@ class ValidationPipeline:
             # then propagate so the caller can pick another backend.
             self._pending = pairs + self._pending
             raise
+        verify_s = time.monotonic() - t_v0
         oks_good = iter(verdicts)
         oks = np.array(
             [bool(next(oks_good)) if w else False for w in well_formed], bool
@@ -205,6 +224,25 @@ class ValidationPipeline:
         self.stats["validated"] += len(batch)
         self.stats["accepted"] += int(np.sum(oks))
         self.stats["rejected"] += len(batch) - int(np.sum(oks))
+        if self.metrics is not None:
+            self.metrics.inc("crypto.pipeline.validated", len(batch))
+            self.metrics.inc("crypto.pipeline.accepted", int(np.sum(oks)))
+            self.metrics.inc(
+                "crypto.pipeline.rejected", len(batch) - int(np.sum(oks))
+            )
+            self.metrics.gauge("crypto.pipeline.verify_s", verify_s)
+            self.metrics.gauge("crypto.pipeline.batch", len(batch))
+        if self.tracer is not None:
+            from ..obs.spans import envelope_span_key
+
+            for (env, ctx), ok in zip(pairs, oks):
+                key = envelope_span_key(env.payload, ctx)
+                if key is not None:
+                    self.tracer.stamp(key, "verify_verdict", ok=bool(ok))
+                    if not ok:
+                        # A rejected envelope never publishes: its span
+                        # ends here, explicitly, instead of dangling open.
+                        self.tracer.close(key, status="rejected")
         if self.on_verdict is not None:
             for env, ok in out:
                 self.on_verdict(env, ok)

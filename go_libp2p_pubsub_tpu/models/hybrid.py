@@ -584,6 +584,38 @@ class HybridGossipSub:
             "step": g.step,
         }
 
+    @functools.partial(jax.jit, static_argnums=(0, 2))
+    def stream_deliver_steps(
+        self, st: HybridState, chunk_steps: int, completion_frac
+    ) -> jax.Array:
+        """Per-slot delivery round within the chunk that just ran, in the
+        engine's [T=1, M] shape: the first of the chunk's ``chunk_steps``
+        rounds at which the count of participants with ``first_step <=
+        round`` reached ``max(1, completion_frac * participants)`` (the
+        coded merge stamps ``first_step`` too, so decoded-generation
+        deliveries resolve exactly like eager ones); the chunk's first
+        round when the threshold was crossed before it, -1 where it has
+        not been crossed.  Counting over the chunk's rounds instead of
+        sorting all N receipt steps keeps the traced-path cost a tiny
+        fraction of the chunk itself.  Host-called by the streaming engine
+        only when tracing is on; takes the frac so the engine can dispatch
+        it before its blocking digest fetch."""
+        g = st.gossip
+        part = g.alive & g.subscribed
+        participants = part.sum()                     # scalar
+        target = jnp.maximum(
+            1, (completion_frac * participants).astype(jnp.int32)
+        )
+        valid = (g.first_step >= 0) & part[:, None]   # [N, M]
+        cand = g.step - chunk_steps + jnp.arange(chunk_steps)  # [S]
+        counts = (
+            valid[None, :, :]
+            & (g.first_step[None, :, :] <= cand[:, None, None])
+        ).sum(axis=1)                                 # [S, M]
+        crossed = counts >= target                    # [S, M]
+        first = jnp.argmax(crossed, axis=0)           # first crossing idx
+        return jnp.where(crossed.any(axis=0), cand[first], -1)[None, :]
+
     def decode_rank_summary(self, st: HybridState) -> dict:
         """Host-side decode-progress counts for checkpoint meta: how many
         (peer, generation) bases are mid-decode vs fully decoded over live

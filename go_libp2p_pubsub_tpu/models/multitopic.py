@@ -730,3 +730,35 @@ class MultiTopicGossipSub:
             "msg_birth": st.msg_birth,
             "step": st.step,
         }
+
+    @functools.partial(jax.jit, static_argnums=(0, 2))
+    def stream_deliver_steps(
+        self, st: MultiTopicState, chunk_steps: int, completion_frac
+    ) -> jax.Array:
+        """Per-(topic, slot) delivery ROUND within the chunk that just ran:
+        the first of the chunk's ``chunk_steps`` rounds at which the count
+        of participants with ``first_step <= round`` reached ``max(1,
+        completion_frac * participants[t])``; the chunk's first round when
+        the threshold was already crossed before it (the engine clamps to
+        the chunk window anyway), -1 where it has not been crossed.
+        Counting over the chunk's candidate rounds instead of sorting all
+        N first-receipt steps keeps the traced-path cost a tiny fraction
+        of the chunk itself.  Host-called by the streaming engine only
+        when tracing is on — it is a separate jitted digest, never part of
+        the resident chunk, and it takes the frac (not host-computed
+        targets) so the engine can dispatch it before its blocking digest
+        fetch."""
+        topic_alive = self._topic_alive(st)           # [T, N]
+        participants = topic_alive.sum(axis=1)        # [T]
+        targets = jnp.maximum(
+            1, (completion_frac * participants).astype(jnp.int32)
+        )
+        valid = (st.first_step >= 0) & topic_alive[:, :, None]  # [T, N, M]
+        cand = st.step - chunk_steps + jnp.arange(chunk_steps)  # [S]
+        counts = (
+            valid[:, None, :, :]
+            & (st.first_step[:, None, :, :] <= cand[None, :, None, None])
+        ).sum(axis=2)                                 # [T, S, M]
+        crossed = counts >= targets[:, None, None]    # [T, S, M]
+        first = jnp.argmax(crossed, axis=1)           # first crossing idx
+        return jnp.where(crossed.any(axis=1), cand[first], -1)  # [T, M]

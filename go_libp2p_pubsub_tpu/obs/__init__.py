@@ -1,0 +1,38 @@
+"""Message-lifecycle tracing and unified telemetry (r18).
+
+Three pieces, one plane:
+
+- :mod:`.spans` — a sampled per-message span ledger keyed on the r14
+  ``content_hash`` identity, stamped by the ingest ring, the validation
+  pipeline, and the streaming engine; exported as Chrome-trace/Perfetto
+  JSON (same envelope as ``utils.trace.StepTimer``) and an OTLP-shaped
+  record.  Spans ride the engine's checkpoint so a crash is an annotated
+  gap, not a hole.
+- :mod:`.blackbox` — a bounded ring of last-K per-chunk telemetry frames
+  the watchdog dumps to a post-mortem JSON on ``restart_engine``.
+- :mod:`.server` — the serving plane's ``/metrics`` endpoint: one
+  :class:`~..utils.metrics.MetricsRegistry` shared by engine, ring,
+  watchdog, and pipeline, rendered through ``render_prometheus``.
+- :mod:`.export` — trace-artifact builders for all three scenario planes
+  (``--trace-out``), summarized by ``tools/trace_view.py``.
+
+Everything here is host-side and strictly additive: with no tracer
+installed the serving plane runs bit- and counter-identical to r17.
+"""
+
+from .blackbox import BlackBox
+from .export import build_record_artifact, build_span_artifact, write_json
+from .server import ObsHTTPServer
+from .spans import STAGES, SpanLedger, content_hash, envelope_span_key
+
+__all__ = [
+    "BlackBox",
+    "ObsHTTPServer",
+    "STAGES",
+    "SpanLedger",
+    "build_record_artifact",
+    "build_span_artifact",
+    "content_hash",
+    "envelope_span_key",
+    "write_json",
+]

@@ -161,8 +161,14 @@ def run_live_scenario(
     n_hosts: Optional[int] = None,
     step_s: Optional[float] = None,
     settle_s: Optional[float] = None,
+    trace_out: Optional[str] = None,
 ) -> LiveScenarioResult:
-    """Lower ``spec`` onto a live tree under chaos and grade its SLOs."""
+    """Lower ``spec`` onto a live tree under chaos and grade its SLOs.
+
+    ``trace_out`` writes an ``obs-record-trace/1`` artifact from the
+    synthesized flight record; the live plane steps on a real cadence, so
+    the trace's time axis is seconds (``step_s`` per step).
+    """
     _reject_unsupported(spec)
     live_cfg = spec.live or {}
     n = int(n_hosts if n_hosts is not None else live_cfg.get("n_hosts", 16))
@@ -198,8 +204,17 @@ def run_live_scenario(
         raise LivePlaneError(f"live plane failed to start: {e}") from e
 
     try:
-        return _drive(spec, net, chaos, hosts, topic, members, n, T, dt,
-                      settle_s, t_begin)
+        res = _drive(spec, net, chaos, hosts, topic, members, n, T, dt,
+                     settle_s, t_begin)
+        if trace_out is not None:
+            from ..obs.export import build_record_artifact, write_json
+
+            write_json(trace_out, build_record_artifact(
+                plane="live", scenario=spec.name,
+                verdict=res.verdict.to_dict(), record=res.record,
+                time_per_step_s=dt,
+            ))
+        return res
     finally:
         for gens in members.values():
             for m in gens:

@@ -63,8 +63,15 @@ def _run_compiled(comp: CompiledScenario):
 
 def run_scenario(
     spec_or_compiled: Union[ScenarioSpec, CompiledScenario],
+    trace_out: Optional[str] = None,
 ) -> ScenarioResult:
-    """Compile (if needed) and execute one scenario, verdict included."""
+    """Compile (if needed) and execute one scenario, verdict included.
+
+    ``trace_out`` writes an ``obs-record-trace/1`` artifact: the sim plane
+    has no host clock (one scan, device time only), so the trace's time
+    axis is the step index and the channels are the flight record's
+    per-step series rendered as Chrome counter events.
+    """
     comp = (
         spec_or_compiled
         if isinstance(spec_or_compiled, CompiledScenario)
@@ -73,6 +80,13 @@ def run_scenario(
     final, record_dev = _run_compiled(comp)
     record = {k: np.asarray(v) for k, v in record_dev.items()}
     verdict = slo_mod.evaluate(comp.spec, record, comp.n_publishes)
+    if trace_out is not None:
+        from ..obs.export import build_record_artifact, write_json
+
+        write_json(trace_out, build_record_artifact(
+            plane="sim", scenario=comp.spec.name,
+            verdict=verdict.to_dict(), record=record,
+        ))
     return ScenarioResult(
         compiled=comp, final_state=final, record=record, verdict=verdict
     )

@@ -105,8 +105,19 @@ def run_streaming_scenario(
     spec: ScenarioSpec,
     max_drain_chunks: int = 64,
     signer_backend: str = "auto",
+    trace_out: Optional[str] = None,
+    trace_sample: int = 1,
 ) -> StreamingScenarioResult:
-    """Execute ``spec`` on the streaming plane and grade its SLOs."""
+    """Execute ``spec`` on the streaming plane and grade its SLOs.
+
+    ``trace_out`` (r18): write the span artifact — per-message lifecycle
+    spans through ring/pipeline/engine, ledger events, Chrome trace, OTLP
+    record, Prometheus render, black-box frames — next to the verdict.
+    With ``trace_out=None`` no observability object exists and the run is
+    bit- and counter-identical to the untraced r17 path.  A staged crash
+    discards the live ledger with the rest of the host state (honest
+    loss); the restore path reinstates the checkpointed spans and
+    annotates the reopened ones with the measured recovery gap."""
     from ..crypto import native
     from ..crypto.pipeline import ValidationPipeline, sign_envelope
     from ..serve import IngestRing, StreamingEngine, Watchdog
@@ -126,9 +137,26 @@ def run_streaming_scenario(
         ckpt_dir = tempfile.mkdtemp(prefix="stream-ckpt-")
         ckpt_path = os.path.join(ckpt_dir, "engine.ckpt")
 
+    tracing = trace_out is not None
+    obs: Dict[str, Any] = {"ledger": None}
+    obs_registry = None
+    obs_blackbox = None
+    if tracing:
+        from ..obs.blackbox import BlackBox
+        from ..obs.spans import SpanLedger
+        from ..utils.metrics import MetricsRegistry
+
+        # One registry + one black box for the whole run (the monitoring
+        # plane survives engine crashes); the span ledger is host state of
+        # the serving pair and is lost/restored WITH it.
+        obs_registry = MetricsRegistry(clock=clock)
+        obs_blackbox = BlackBox(capacity=64, clock=clock)
+        obs["ledger"] = SpanLedger(sample_n=trace_sample, clock=clock)
+
     def _mk_pair(seed: int):
         ring = IngestRing(
-            capacity=plan.capacity, policy=plan.policy, clock=clock
+            capacity=plan.capacity, policy=plan.policy, clock=clock,
+            metrics=obs_registry, tracer=obs["ledger"],
         )
         engine = StreamingEngine(
             model,
@@ -140,6 +168,9 @@ def run_streaming_scenario(
             clock=clock,
             snapshot_path=ckpt_path,
             snapshot_every=plan.snapshot_every,
+            metrics=obs_registry,
+            tracer=obs["ledger"],
+            blackbox=obs_blackbox,
         )
         return ring, engine
 
@@ -168,6 +199,11 @@ def run_streaming_scenario(
         watchdog = Watchdog(
             engine, ring, checkpoint_path=ckpt_path,
             chunk_stall_s=3600.0, clock=clock,
+            metrics=obs_registry,
+            blackbox=obs_blackbox,
+            postmortem_path=(
+                f"{trace_out}.postmortem.json" if tracing else None
+            ),
         )
 
     # Crypto stage ahead of enqueue: the verdict callback is the ONLY path
@@ -196,7 +232,8 @@ def run_streaming_scenario(
 
     def _mk_pipe():
         return ValidationPipeline(
-            backend=backend, flush_threshold=4096, on_verdict_ctx=_admit
+            backend=backend, flush_threshold=4096, on_verdict_ctx=_admit,
+            tracer=obs["ledger"], metrics=obs_registry,
         )
 
     pipe = _mk_pipe()
@@ -262,7 +299,14 @@ def run_streaming_scenario(
             # Honest host-state loss: engine AND ring discarded.  Recovery
             # = fresh pair over an equal model (warmup reuses the shared
             # compiled chunk — no recompile) + watchdog-driven restore.
+            # The span ledger dies with them — the fresh one is populated
+            # from the checkpoint by restore(), so spans closed since the
+            # last snapshot are honestly lost, not resurrected.
             t_crash = time.monotonic()
+            if tracing:
+                from ..obs.spans import SpanLedger as _Ledger
+
+                obs["ledger"] = _Ledger(sample_n=trace_sample, clock=clock)
             ring, engine = _mk_pair(spec.seed + 1)
             try:
                 engine.warmup()
@@ -279,6 +323,8 @@ def run_streaming_scenario(
             replayed_total += info["replayed"]
             recovery_s_list.append(time.monotonic() - t_crash)
             holder["ring"] = ring
+            # The surviving pipeline must stamp into the NEW ledger.
+            pipe.tracer = obs["ledger"]
         skew = faults.get("clock_skew")
         if skew is not None and skew["at_chunk"] == chunk_index:
             clock.offset += skew["skew_s"]
@@ -400,6 +446,32 @@ def run_streaming_scenario(
         record["eager_p99_s"] = np.asarray([eager_p99], np.float64)
         record["p99_vs_eager_ratio"] = np.asarray([p99_ratio], np.float64)
     verdict = slo_mod.evaluate(spec, record, plan.n_publishes)
+    trace_summary: Optional[Dict[str, Any]] = None
+    if tracing:
+        from ..obs.export import build_span_artifact, write_json
+
+        ledger = obs["ledger"]
+        artifact = build_span_artifact(
+            plane="streaming",
+            scenario=spec.name,
+            verdict=verdict.to_dict(),
+            ledger=ledger,
+            registry=obs_registry,
+            blackbox=obs_blackbox,
+            extra={
+                "recovery_s": (
+                    max(recovery_s_list) if recovery_s_list else 0.0
+                ),
+                "recovery_gap_s": engine.last_recovery_gap_s,
+                "chunk_wall_s": engine.last_chunk_wall_s,
+                "latency": {
+                    "chunk": q,
+                    "exact": engine.latency_quantiles(mode="exact"),
+                },
+            },
+        )
+        write_json(trace_out, artifact)
+        trace_summary = ledger.summary()
     if ckpt_dir is not None:
         shutil.rmtree(ckpt_dir, ignore_errors=True)
     return StreamingScenarioResult(
@@ -431,6 +503,9 @@ def run_streaming_scenario(
             "recovery_s_list": list(recovery_s_list),
             "eager_completed": eager_completed,
             "pipeline": dict(pipe.stats),
+            "trace_out": trace_out,
+            "trace_summary": trace_summary,
+            "recovery_gap_s": engine.last_recovery_gap_s,
         },
         seconds=time.monotonic() - t0,
     )

@@ -40,7 +40,6 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
-import hashlib
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -66,8 +65,10 @@ except ImportError:  # pragma: no cover - jax moved the State: global flip
                 "jax_persistent_cache_min_compile_time_secs", old)
 
 from ..models.multitopic import MultiTopicGossipSub
+from ..obs.spans import content_hash  # canonical definition (r18); re-exported
 from ..ops import schedule as sched
 from ..utils import checkpoint as ckpt
+from ..utils.trace import xla_trace
 from .ingest import IngestItem, IngestRing
 
 # The resident program per model VALUE (models define __eq__/__hash__ over
@@ -87,17 +88,6 @@ def _resident_rollout(model: MultiTopicGossipSub):
         )
         _ROLLOUT_CACHE[model] = fn
     return fn
-
-
-def content_hash(topic: int, publisher: int, payload: bytes) -> str:
-    """Stable identity of a publish for exactly-once dedup (hex).  Keyed on
-    content, not ring seq — a resubmitted message gets a fresh seq but the
-    same hash."""
-    h = hashlib.sha256()
-    h.update(int(topic).to_bytes(4, "little"))
-    h.update(int(publisher).to_bytes(8, "little"))
-    h.update(payload)
-    return h.hexdigest()[:32]
 
 
 @dataclasses.dataclass
@@ -136,6 +126,10 @@ class StreamingEngine:
         clock=time.monotonic,
         snapshot_path: Optional[str] = None,
         snapshot_every: int = 0,
+        tracer=None,
+        blackbox=None,
+        profile_every: int = 0,
+        profile_dir: Optional[str] = None,
     ) -> None:
         if chunk_steps < 1 or pub_width < 1:
             raise ValueError("chunk_steps and pub_width must be >= 1")
@@ -145,6 +139,10 @@ class StreamingEngine:
             raise ValueError("snapshot_every must be >= 0")
         if snapshot_every > 0 and snapshot_path is None:
             raise ValueError("snapshot_every needs a snapshot_path")
+        if profile_every < 0:
+            raise ValueError("profile_every must be >= 0")
+        if profile_every > 0 and profile_dir is None:
+            raise ValueError("profile_every needs a profile_dir")
         self.model = model
         self.ring = ring
         self.chunk_steps = chunk_steps
@@ -154,6 +152,20 @@ class StreamingEngine:
         self._clock = clock
         self.snapshot_path = snapshot_path
         self.snapshot_every = snapshot_every
+        # Observability plane (r18) — all host-side, all optional; with
+        # every knob at its default the engine is bit- and counter-identical
+        # to the untraced r17 behavior.
+        self.tracer = tracer
+        self.blackbox = blackbox
+        self.profile_every = profile_every
+        self.profile_dir = profile_dir
+        self.profile_captures = 0
+        self.latencies_exact_s: List[float] = []  # span-interpolated (traced)
+        self.last_recovery_gap_s: Optional[float] = None
+        self.last_chunk_wall_s = 0.0
+        # Set by the watchdog just before restore() so the recovery
+        # annotation on reopened spans carries the tier/reason context.
+        self.recovery_context: Dict[str, str] = {}
         self.state = model.init(seed=seed)
         # The resident program: donated state in, fixed event shapes —
         # shared process-wide per model value (see _ROLLOUT_CACHE), so the
@@ -245,6 +257,12 @@ class StreamingEngine:
                 )
                 self.pending[(item.topic, slot)] = p
                 self.publish_log.append(p)
+                if self.tracer is not None:
+                    self.tracer.stamp(
+                        chash, "chunk_dispatch", t=t_dispatch,
+                        chunk=self.chunks_run, step=p.step_published,
+                        slot=slot,
+                    )
             else:
                 self.invalid_published.append((item.topic, slot))
             self.published += 1
@@ -320,7 +338,15 @@ class StreamingEngine:
             "completed_hashes": sorted(self._completed_hashes),
             "ring": self.ring.snapshot(),
             "ingress_delay": self.ingress_delay,
+            # r18 observability: the wall stamp dates the checkpoint so a
+            # restore can measure the crash gap; span state rides along so
+            # in-flight spans survive (absent when untraced — restore
+            # tolerates both).
+            "t_wall": self._clock(),
+            "latencies_exact_s": list(self.latencies_exact_s),
         }
+        if self.tracer is not None:
+            meta["spans"] = self.tracer.snapshot()
         # Coded models expose decode progress — recorded so an operator
         # (and the crash tests) can see partial ranks were checkpointed
         # mid-generation, not just full decodes.
@@ -414,6 +440,24 @@ class StreamingEngine:
         if meta.get("ingress_delay") is not None:
             self.ingress_delay = int(meta["ingress_delay"])
         replayed = self.ring.restore_snapshot(meta["ring"])
+        self.latencies_exact_s = [
+            float(x) for x in meta.get("latencies_exact_s", [])
+        ]
+        # Recovery gap: how long the world stood still between the
+        # checkpoint's wall stamp and this restore.  Annotated onto every
+        # reopened span (with the watchdog's tier/reason context when it
+        # drove the restart) so a crash reads as a measured gap, not a hole.
+        gap: Optional[float] = None
+        if meta.get("t_wall") is not None:
+            gap = max(0.0, self._clock() - float(meta["t_wall"]))
+            self.last_recovery_gap_s = gap
+        if self.tracer is not None and meta.get("spans") is not None:
+            self.tracer.restore_snapshot(meta["spans"])
+            rctx = {str(k): str(v) for k, v in self.recovery_context.items()}
+            if gap is not None:
+                self.tracer.event("crash_recovery", gap_s=gap, **rctx)
+                self.tracer.annotate_open("crash_recovery", gap_s=gap, **rctx)
+        self.recovery_context = {}
         self.restores += 1
         if self.metrics is not None:
             self.metrics.inc("serve.engine.restores")
@@ -422,16 +466,30 @@ class StreamingEngine:
             "replayed": replayed,
             "pending": len(self.pending),
             "completed": self.completed,
+            "recovery_gap_s": gap,
         }
 
     # -- views --------------------------------------------------------------
 
-    def latency_quantiles(self, qs=(0.5, 0.99)) -> Dict[str, float]:
+    def latency_quantiles(
+        self, qs=(0.5, 0.99), mode: str = "chunk"
+    ) -> Dict[str, float]:
         """{"p50": ..., "p99": ...} over completed ingest→delivery
-        latencies (host seconds); NaN when nothing completed yet."""
+        latencies (host seconds); NaN when nothing completed yet.
+
+        ``mode="chunk"`` is the r12 measurement (delivery observed at the
+        chunk boundary, latencies rounded UP to it).  ``mode="exact"``
+        reads the span plane's device-round interpolation instead —
+        populated only on traced runs, and elementwise ≤ the chunk value
+        by construction, so exact quantiles never exceed chunk ones."""
         from ..utils.metrics import quantiles
 
-        return quantiles(self.latencies_s, qs)
+        if mode == "chunk":
+            return quantiles(self.latencies_s, qs)
+        if mode == "exact":
+            return quantiles(self.latencies_exact_s, qs)
+        raise ValueError(f"unknown latency mode {mode!r}; "
+                         "have: chunk, exact")
 
     # -- internals ----------------------------------------------------------
 
@@ -460,21 +518,58 @@ class StreamingEngine:
             self.evicted += 1
             if self.metrics is not None:
                 self.metrics.inc("serve.engine.evicted")
+            if self.tracer is not None and stale.chash:
+                self.tracer.close(stale.chash, status="evicted")
         return slot
 
     def _dispatch(self, events: sched.MultiTopicEvents, n_items: int = 0):
+        t_start = self._clock()
+        # Flag-gated XLA capture every Nth chunk (off by default; never on
+        # warmup chunks) — the on-chip campaign's free profiler hook.
+        do_profile = (
+            self.profile_every > 0
+            and not getattr(self, "_in_warmup", False)
+            and (self.chunks_run + 1) % self.profile_every == 0
+        )
+        profiler = (
+            xla_trace(self.profile_dir) if do_profile
+            else contextlib.nullcontext()
+        )
         # Chunk executables must NEVER enter the persistent compile cache:
         # the CPU backend segfaults executing a DESERIALIZED donated-state
         # chunk program (see tests/conftest.py).  The repo-wide 10 s floor
         # only keeps them out while compiles stay fast — on a loaded box a
         # chunk compile crosses the floor and poisons the cache for every
         # later process.  Opt out at the one site that compiles them.
-        with _persistent_cache_floor(float("inf")):
+        with profiler, _persistent_cache_floor(float("inf")):
             self.state, record = self._rollout(self.state, events)
+        if do_profile:
+            self.profile_captures += 1
+        # Exact device rounds (traced runs only): a separate host-called
+        # jitted digest over the persistent first-receipt record — the
+        # resident chunk program itself is untouched, so tracing can never
+        # change device semantics or add a compiled chunk variant.
+        # Dispatched asynchronously BEFORE the blocking digest fetch so its
+        # compute overlaps the sync the engine pays anyway; only the (tiny)
+        # result transfer below is tracing-specific latency.
+        deliver_dev = None
+        if self.tracer is not None:
+            fn = getattr(self.model, "stream_deliver_steps", None)
+            if fn is not None:
+                deliver_dev = fn(
+                    self.state, self.chunk_steps, self.completion_frac
+                )
         digest = jax.device_get(self.model.stream_digest(self.state))
         t_done = self._clock()
         self.chunks_run += 1
-        completed_now = self._fold_completions(digest, t_done)
+        self.last_chunk_wall_s = t_done - t_start
+        deliver_steps = (
+            np.asarray(jax.device_get(deliver_dev))
+            if deliver_dev is not None else None
+        )
+        completed_now = self._fold_completions(
+            digest, t_done, t_start=t_start, deliver_steps=deliver_steps
+        )
         # Flight-recorder tail: the final round of each telemetry channel
         # (one device_get; lat_hist's last row is the window-cumulative
         # histogram at the chunk boundary).
@@ -485,6 +580,30 @@ class StreamingEngine:
         if self.metrics is not None:
             self.metrics.gauge("serve.engine.pending", len(self.pending))
             self.metrics.inc("serve.engine.chunks")
+        if self.blackbox is not None:
+            acct = self.ring.accounting()
+            frame = {
+                "chunk": self.chunks_run - 1,
+                "step": int(digest["step"]),
+                "items": n_items,
+                "completed_now": completed_now,
+                "pending": len(self.pending),
+                "queue_depth": acct["in_queue"],
+                "chunk_wall_s": self.last_chunk_wall_s,
+                "published": self.published,
+                "completed": self.completed,
+                "evicted": self.evicted,
+                "replay_deduped": self.replay_deduped,
+                "shed_priority": acct["shed_priority"],
+                "dropped_oldest": acct["dropped_oldest"],
+                "rejected": acct["rejected"],
+                "warmup": bool(getattr(self, "_in_warmup", False)),
+            }
+            if self.metrics is not None:
+                v = self.metrics.latest("crypto.pipeline.verify_s")
+                if v is not None:
+                    frame["verify_s"] = v
+            self.blackbox.record(frame)
         if (
             self.snapshot_every > 0
             and not getattr(self, "_in_warmup", False)
@@ -499,9 +618,16 @@ class StreamingEngine:
             "step": int(digest["step"]),
         }
 
-    def _fold_completions(self, digest: dict, t_done: float) -> int:
+    def _fold_completions(
+        self,
+        digest: dict,
+        t_done: float,
+        t_start: Optional[float] = None,
+        deliver_steps: Optional[np.ndarray] = None,
+    ) -> int:
         delivered = np.asarray(digest["delivered"])        # [T, M]
         participants = np.asarray(digest["participants"])  # [T]
+        step_end = int(digest["step"])
         done = 0
         for (topic, slot), p in list(self.pending.items()):
             target = max(1, int(self.completion_frac * participants[topic]))
@@ -516,6 +642,33 @@ class StreamingEngine:
                         self.metrics.inc("serve.engine.clock_anomalies")
                     lat = 0.0
                 self.latencies_s.append(lat)
+                if self.tracer is not None:
+                    # Exact delivery time: the chunk-boundary stamp pulled
+                    # back to the message's actual device round, linearly
+                    # interpolated inside this chunk's host wall window.
+                    # r_local is clamped to the chunk, so t_exact <= t_done
+                    # and the exact latency never exceeds the chunk-
+                    # quantized one.
+                    t_exact = t_done
+                    r = -1
+                    if deliver_steps is not None and t_start is not None:
+                        r = int(deliver_steps[topic, slot])
+                        if r >= 0:
+                            r_local = min(
+                                max(r - (step_end - self.chunk_steps), 0),
+                                self.chunk_steps - 1,
+                            )
+                            t_exact = t_start + (
+                                (r_local + 1) / self.chunk_steps
+                            ) * (t_done - t_start)
+                    lat_exact = min(max(0.0, t_exact - p.t_ingest), lat)
+                    self.latencies_exact_s.append(lat_exact)
+                    if p.chash:
+                        self.tracer.stamp(
+                            p.chash, "device_delivery", t=t_exact,
+                            round=r, lat_s=lat_exact, lat_chunk_s=lat,
+                        )
+                        self.tracer.close(p.chash, t=t_exact)
                 self.completed += 1
                 if p.chash:
                     if p.chash in self._completed_hashes:

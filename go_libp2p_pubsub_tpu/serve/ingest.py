@@ -47,6 +47,8 @@ import time
 from dataclasses import dataclass
 from typing import FrozenSet, Iterable, List, Optional
 
+from ..obs.spans import content_hash
+
 BACKPRESSURE_POLICIES = ("block", "drop_oldest", "reject")
 
 
@@ -78,6 +80,7 @@ class IngestRing:
         policy: str = "block",
         metrics=None,
         clock=time.monotonic,
+        tracer=None,
     ) -> None:
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
@@ -89,6 +92,7 @@ class IngestRing:
         self.capacity = capacity
         self.policy = policy
         self.metrics = metrics
+        self.tracer = tracer   # optional obs.SpanLedger (ring_accept stamps)
         self._clock = clock
         self._buf: List[Optional[IngestItem]] = [None] * capacity
         self._head = 0          # index of the oldest item
@@ -158,6 +162,12 @@ class IngestRing:
             self.max_depth = max(self.max_depth, self._size)
             self._metric_inc("serve.ingest.accepted")
             self._metric_depth()
+            if self.tracer is not None and item.valid:
+                self.tracer.stamp(
+                    content_hash(item.topic, item.publisher, item.payload),
+                    "ring_accept", t=item.t_ingest,
+                    seq=item.seq, topic=item.topic,
+                )
             return True
 
     # -- consumer side ------------------------------------------------------

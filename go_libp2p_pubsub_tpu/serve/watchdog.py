@@ -63,6 +63,8 @@ class Watchdog:
         on_verifier_restart: Optional[Callable[[], None]] = None,
         metrics=None,
         clock=time.monotonic,
+        blackbox=None,
+        postmortem_path: Optional[str] = None,
     ) -> None:
         if chunk_stall_s <= 0:
             raise ValueError("chunk_stall_s must be > 0")
@@ -101,6 +103,11 @@ class Watchdog:
         self.on_verifier_restart = on_verifier_restart
         self.metrics = metrics
         self.clock = clock
+        # r18 black box: when wired, restart_engine dumps the last-K chunk
+        # frames to ``postmortem_path`` — the forensic record of the run-up
+        # to the death, not just the final counters.
+        self.blackbox = blackbox
+        self.postmortem_path = postmortem_path
         self.tier = 0
         self._orig_policy = ring.policy
         self._last_chunk: Optional[float] = None
@@ -162,12 +169,32 @@ class Watchdog:
         chaos runner, a process supervisor) can restart without waiting out
         the stall threshold."""
         path = self.checkpoint_path
+        if hasattr(self.engine, "recovery_context"):
+            # Hand the restore path the supervision context so reopened
+            # spans are annotated with WHY the world stopped, not just for
+            # how long.
+            self.engine.recovery_context = {
+                "tier": self.tier_name, "reason": reason,
+            }
         info = self.engine.restore(path)
         self.engine_restarts += 1
         self._inc("serve.watchdog.engine_restarts")
         self._last_chunk = self.clock()
         self.tier_log.append((self.clock(), TIER_NAMES[self.tier],
                               f"engine restart: {reason}"))
+        tracer = getattr(self.engine, "tracer", None)
+        if tracer is not None:
+            tracer.event("engine_restart", t=self.clock(), reason=reason,
+                         tier=self.tier_name)
+        if self.blackbox is not None and self.postmortem_path is not None:
+            self.blackbox.dump(self.postmortem_path, extra={
+                "reason": reason,
+                "tier": self.tier_name,
+                "engine_restarts": self.engine_restarts,
+                "tier_log": [[t, name, why]
+                             for t, name, why in self.tier_log],
+                "restore_info": dict(info),
+            })
         if self.on_engine_restart is not None:
             self.on_engine_restart(info)
         return info
@@ -192,6 +219,12 @@ class Watchdog:
         self._inc("serve.watchdog.tier_changes")
         if self.metrics is not None:
             self.metrics.gauge("serve.watchdog.tier", tier)
+        tracer = getattr(self.engine, "tracer", None)
+        if tracer is not None:
+            # tier_log transitions double as ledger events, so the trace
+            # timeline shows WHEN the ladder moved among the spans it bent.
+            tracer.event("watchdog_tier", t=self.clock(),
+                         tier=TIER_NAMES[tier], reason=reason)
 
     def _inc(self, name: str) -> None:
         if self.metrics is not None:

@@ -147,12 +147,33 @@ class MetricsRegistry:
         self._clock = clock
         self._counters: Dict[str, float] = {}
         self._series: Dict[str, List[Tuple[float, float]]] = {}
+        # Decorated key -> (base name, sorted (label, value) items); plain
+        # keys have no entry and render label-less.
+        self._meta: Dict[str, Tuple[str, Tuple[Tuple[str, str], ...]]] = {}
+        self._help: Dict[str, str] = {}
 
-    def inc(self, name: str, value: float = 1.0) -> None:
-        self._counters[name] = self._counters.get(name, 0.0) + value
+    def inc(self, name: str, value: float = 1.0,
+            labels: Optional[Dict[str, str]] = None) -> None:
+        key = self._key(name, labels)
+        self._counters[key] = self._counters.get(key, 0.0) + value
 
-    def gauge(self, name: str, value: float) -> None:
-        self._series.setdefault(name, []).append((self._clock(), float(value)))
+    def gauge(self, name: str, value: float,
+              labels: Optional[Dict[str, str]] = None) -> None:
+        key = self._key(name, labels)
+        self._series.setdefault(key, []).append((self._clock(), float(value)))
+
+    def describe(self, name: str, help_text: str) -> None:
+        """Attach a HELP string to a metric's BASE name (pre-sanitization);
+        undescribed metrics render their original dotted name as HELP."""
+        self._help[name] = help_text
+
+    def _key(self, name: str, labels: Optional[Dict[str, str]]) -> str:
+        if not labels:
+            return name
+        items = tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+        key = name + "{" + ",".join(f"{k}={v}" for k, v in items) + "}"
+        self._meta[key] = (name, items)
+        return key
 
     def observe_state(self, prefix: str, metrics: Dict[str, jax.Array]) -> None:
         """Record a device metrics dict as gauges under ``prefix.*``."""
@@ -187,20 +208,42 @@ class MetricsRegistry:
 
     def render_prometheus(self) -> str:
         """Prometheus text exposition (format version 0.0.4) of all counters
-        and the latest sample of every gauge series — the body the live
-        plane's ``/metrics`` endpoint serves.  Names are sanitized to the
-        metric grammar (dots and other illegal runes become ``_``); counters
-        get the conventional ``_total`` suffix."""
+        and the latest sample of every gauge series — the body the live and
+        serving planes' ``/metrics`` endpoints serve.  Audited against the
+        exposition format (r18): per-metric ``# HELP`` + ``# TYPE`` lines
+        (HELP defaults to the original dotted name, with backslash/newline
+        escaping), names sanitized to the metric grammar (dots and other
+        illegal runes become ``_``), counters suffixed ``_total``, and
+        labeled series rendered with escaped label values under ONE shared
+        HELP/TYPE header per base metric."""
         lines: List[str] = []
-        for name in sorted(self._counters):
-            pn = _prometheus_name(name) + "_total"
-            lines.append(f"# TYPE {pn} counter")
-            lines.append(f"{pn} {_prometheus_value(self._counters[name])}")
-        for name in sorted(self._series):
-            pn = _prometheus_name(name)
-            lines.append(f"# TYPE {pn} gauge")
-            lines.append(f"{pn} {_prometheus_value(self._series[name][-1][1])}")
+        self._render_family(lines, "counter", self._counters,
+                            lambda v: v)
+        self._render_family(lines, "gauge", self._series,
+                            lambda s: s[-1][1])
         return "\n".join(lines) + "\n"
+
+    def _render_family(self, lines: List[str], kind: str, store: Dict,
+                       value_of) -> None:
+        groups: Dict[str, List[Tuple[Tuple[Tuple[str, str], ...], Any]]] = {}
+        for key in store:
+            base, labels = self._meta.get(key, (key, ()))
+            groups.setdefault(base, []).append((labels, value_of(store[key])))
+        for base in sorted(groups):
+            pn = _prometheus_name(base) + ("_total" if kind == "counter"
+                                           else "")
+            help_text = _prometheus_help(self._help.get(base, base))
+            lines.append(f"# HELP {pn} {help_text}")
+            lines.append(f"# TYPE {pn} {kind}")
+            for labels, value in sorted(groups[base], key=lambda p: p[0]):
+                label_str = ""
+                if labels:
+                    label_str = "{" + ",".join(
+                        f'{_prometheus_label_name(k)}='
+                        f'"{_prometheus_label_value(v)}"'
+                        for k, v in labels
+                    ) + "}"
+                lines.append(f"{pn}{label_str} {_prometheus_value(value)}")
 
 
 def _prometheus_name(name: str) -> str:
@@ -209,6 +252,28 @@ def _prometheus_name(name: str) -> str:
     if not name or not re.match(r"[a-zA-Z_:]", name[0]):
         name = "_" + name
     return name
+
+
+def _prometheus_label_name(name: str) -> str:
+    """Label-name grammar is the metric grammar WITHOUT colons:
+    ``[a-zA-Z_][a-zA-Z0-9_]*``."""
+    name = re.sub(r"[^a-zA-Z0-9_]", "_", name)
+    if not name or not re.match(r"[a-zA-Z_]", name[0]):
+        name = "_" + name
+    return name
+
+
+def _prometheus_label_value(v: str) -> str:
+    """Escape a label value per the exposition format: backslash, double
+    quote, and line feed."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _prometheus_help(text: str) -> str:
+    """HELP text escaping: backslash and line feed only (quotes are legal
+    in HELP)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _prometheus_value(v: float) -> str:

@@ -381,6 +381,71 @@ def test_runner_clock_skew_clamps_and_counts():
     assert res.record["ingest_lat_p50_s"][-1] >= 0  # clamped, never negative
 
 
+def test_runner_crash_closes_every_sampled_span_with_gap(tmp_path):
+    """r18 satellite: kill mid-run, restore, and every sampled message
+    still closes exactly ONE span — in-flight spans ride the checkpoint
+    meta across the crash and come back annotated with the measured
+    recovery gap (watchdog tier + reason attached)."""
+    out = str(tmp_path / "trace.json")
+    # Short chunks (own model config, same discipline as the clock-skew
+    # test) so deliveries are STILL IN FLIGHT at the kill — a 6-step chunk
+    # completes this tiny model's messages before any crash could strand
+    # them, and only open spans get the gap annotation.
+    spec = _fault_spec(
+        model=dict(_CRASH_TINY, msg_window=30),
+        streaming={"chunk_steps": 2, "snapshot_every": 1,
+                   "crash_at_chunk": 1})
+    res = scenario.run_streaming_scenario(spec, trace_out=out)
+    assert res.verdict.passed, str(res.verdict)
+    assert res.engine_stats["restores"] == 1
+    art = json.load(open(out))
+    s = art["summary"]
+    assert s["spans"] > 0
+    assert s["open"] == 0, f"{s['open']} spans never closed after restore"
+    assert s["closed"] == s["spans"]
+    assert s["duplicate_closes"] == 0, "a span closed more than once"
+    # the spans that were in flight at the kill carry the gap annotation
+    gaps = [e for sp in art["spans"] for e in sp["events"]
+            if e["name"] == "crash_recovery"]
+    assert gaps, "no span annotated with the recovery gap"
+    for e in gaps:
+        assert e["gap_s"] > 0
+        assert e["tier"] in ("normal", "shed_priority", "drop_oldest")
+        assert "reason" in e
+    # engine_stats mirrors the artifact so non-artifact callers see it too
+    assert res.engine_stats["recovery_gap_s"] is not None
+    assert res.engine_stats["trace_summary"]["open"] == 0
+
+
+@pytest.mark.slow
+def test_crash_canon_traced_gap_matches_recovery():
+    """r18 acceptance on the registered canon: tracing on, the span
+    artifact's annotated recovery gap agrees with the runner's measured
+    ``recovery_s`` to within one chunk wall time (the gap clock starts at
+    the last pre-crash snapshot, the runner's at the kill — at
+    snapshot_every=1 they differ by at most the chunk in between)."""
+    import tempfile
+
+    spec = scenario.CANON["streaming_engine_crash_recovery"]()
+    out = os.path.join(tempfile.mkdtemp(prefix="obs-canon-"), "trace.json")
+    res = scenario.run_streaming_scenario(spec, trace_out=out)
+    assert res.verdict.passed, str(res.verdict)
+    assert res.engine_stats["compile_cache_size"] == 1
+    art = json.load(open(out))
+    assert art["summary"]["open"] == 0
+    assert art["summary"]["duplicate_closes"] == 0
+    gaps = [e["gap_s"] for sp in art["spans"] for e in sp["events"]
+            if e["name"] == "crash_recovery"]
+    assert gaps, "traced canon produced no recovery-gap annotations"
+    recovery_s = art["recovery_s"]
+    wall = art["chunk_wall_s"]
+    assert recovery_s > 0
+    for g in gaps:
+        assert abs(g - recovery_s) <= wall + 0.05, (
+            f"gap {g:.3f}s vs recovery {recovery_s:.3f}s "
+            f"(chunk wall {wall:.3f}s)")
+
+
 def test_fault_lowering_validates():
     with pytest.raises(ValueError, match="crash_at_chunk"):
         scenario.compile_streaming_plan(

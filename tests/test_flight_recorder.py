@@ -208,16 +208,23 @@ def test_prometheus_exposition_format():
     body = reg.render_prometheus()
     assert body.endswith("\n")
     lines = body.splitlines()
+    # r18: every family is a HELP/TYPE pair followed by its samples.
     seen = {}
-    for type_line, sample in zip(lines[::2], lines[1::2]):
-        m = re.match(r"^# TYPE (\S+) (counter|gauge)$", type_line)
-        assert m, type_line
-        name, kind = m.groups()
-        assert PROM_NAME.match(name), name
-        sname, _, value = sample.partition(" ")
-        assert sname == name
+    helped = set()
+    kind_of = {}
+    for line in lines:
+        if line.startswith("# HELP "):
+            helped.add(line.split(" ", 3)[2])
+            continue
+        m = re.match(r"^# TYPE (\S+) (counter|gauge)$", line)
+        if m:
+            kind_of[m.group(1)] = m.group(2)
+            continue
+        sname, _, value = line.partition(" ")
+        assert PROM_NAME.match(sname), sname
         float(value)  # parses as a Prometheus float (incl. NaN)
-        seen[name] = (kind, value)
+        seen[sname] = (kind_of[sname], value)
+    assert helped == set(kind_of)  # one HELP per TYPE, no strays
     assert seen["bench_rollouts_total"] == ("counter", "3")
     assert seen["gossip_delivery_frac"][0] == "gauge"
     assert seen["weird_name_"] == ("gauge", "NaN")

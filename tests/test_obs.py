@@ -1,0 +1,417 @@
+"""Message-lifecycle tracing: the r18 telemetry plane (ISSUE 14).
+
+Contracts under test, in order of importance:
+
+1. Tracing OFF is bit-identical to not having the subsystem: a traced and
+   an untraced run of the same clean streaming scenario agree leaf-for-leaf
+   on every deterministic record channel and engine counter, and the
+   resident rollout cache stays at exactly one entry either way.
+2. The span ledger closes every sampled message's span exactly once —
+   rejected envelopes and evicted slots close explicitly (status), double
+   closes are counted, stamps after close are ignored — mirroring the
+   engine's exactly-once delivery contract.
+3. Exact-mode latency quantiles (span device-round interpolation) are
+   elementwise <= the chunk-quantized quantiles, by construction.
+4. ``render_prometheus`` speaks text exposition format 0.0.4 verbatim
+   (HELP/TYPE pairs, ``_total`` counters, label escaping) — golden text.
+5. The artifacts are loadable, shaped, and summarized by
+   ``tools/trace_view.py``; ``tools/perf_diff.py`` warns (never crashes)
+   on records that predate the r18 ``obs`` section.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu import scenario
+from go_libp2p_pubsub_tpu.obs import (
+    STAGES,
+    BlackBox,
+    ObsHTTPServer,
+    SpanLedger,
+    content_hash,
+)
+from go_libp2p_pubsub_tpu.utils.metrics import MetricsRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Own model config so this module's shared-rollout cache entry is its own
+# (same discipline as test_crash_safety's _CRASH_TINY).
+_OBS_TINY = dict(n_topics=2, n_peers=16, n_slots=8, conn_degree=4,
+                 msg_window=32, heartbeat_steps=4)
+
+
+def _tiny_spec(**kw):
+    streaming = {"streaming_only": True, "chunk_steps": 6, "capacity": 16,
+                 "policy": "block"}
+    streaming.update(kw.pop("streaming", {}))
+    return scenario.ScenarioSpec(
+        name="tiny_obs_stream",
+        family="multitopic",
+        n_steps=12,
+        seed=5,
+        model=dict(_OBS_TINY),
+        workloads=[scenario.Workload(kind="constant", topic=0, start=0,
+                                     stop=12, every=2)],
+        streaming=streaming,
+        slo=scenario.SLO(min_delivery_frac=0.9, max_queue_depth=16,
+                         max_silent_drops=0),
+        **kw,
+    )
+
+
+# ---------------------------------------------------------------------------
+# span ledger mechanics
+# ---------------------------------------------------------------------------
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        self.t += 0.25
+        return self.t
+
+
+def test_ledger_stamps_and_closes_once():
+    led = SpanLedger(sample_n=1, clock=_FakeClock())
+    key = content_hash(0, 3, b"hello")
+    for stage in STAGES:
+        led.stamp(key, stage)
+    led.close(key)
+    assert led.n_spans == 1 and led.n_closed == 1 and led.n_open == 0
+    led.close(key)                       # second close: counted, not applied
+    assert led.duplicate_closes == 1
+    led.stamp(key, "ring_accept")        # stamp after close: ignored
+    assert len(led.get(key)["stamps"]) == len(STAGES)
+    s = led.summary()
+    assert s["spans"] == 1 and s["closed"] == 1
+    # every adjacent stage pair shows up as a transition with quantiles
+    for a, b in zip(STAGES, STAGES[1:]):
+        assert s["transitions"][f"{a}->{b}"]["count"] == 1
+
+
+def test_ledger_sampling_is_deterministic_on_the_key():
+    led_a = SpanLedger(sample_n=4)
+    led_b = SpanLedger(sample_n=4)
+    keys = [content_hash(t, p, b"payload %d" % i)
+            for i, (t, p) in enumerate((i % 2, i) for i in range(64))]
+    picked_a = [k for k in keys if led_a.sampled(k)]
+    picked_b = [k for k in keys if led_b.sampled(k)]
+    assert picked_a == picked_b          # no shared state, same decisions
+    assert 0 < len(picked_a) < len(keys)
+    for k in keys:
+        led_a.stamp(k, "ring_accept")
+    assert led_a.n_spans == len(picked_a)   # unsampled stamps ignored
+
+
+def test_ledger_close_status_and_events():
+    led = SpanLedger(sample_n=1)
+    k_rej = content_hash(0, 1, b"forged")
+    led.stamp(k_rej, "verify_submit")
+    led.close(k_rej, status="rejected")
+    assert led.get(k_rej)["attrs"]["status"] == "rejected"
+    k_open = content_hash(1, 2, b"inflight")
+    led.stamp(k_open, "ring_accept")
+    led.event("watchdog_tier", tier="shed_priority", reason="depth")
+    led.annotate_open("crash_recovery", gap_s=0.5, tier="normal")
+    span = led.get(k_open)
+    assert any(e["name"] == "crash_recovery" for e in span["events"])
+    assert led.summary()["events"]["watchdog_tier"] == 1
+
+
+def test_ledger_snapshot_restore_roundtrip_and_mismatch():
+    led = SpanLedger(sample_n=2)
+    keys = [content_hash(0, i, b"snap %d" % i) for i in range(16)]
+    for k in keys:
+        led.stamp(k, "ring_accept")
+    snap = json.loads(json.dumps(led.snapshot()))   # must be JSON-safe
+    led2 = SpanLedger(sample_n=2)
+    led2.restore_snapshot(snap)
+    assert led2.n_spans == led.n_spans and led2.n_open == led.n_open
+    bad = SpanLedger(sample_n=3)
+    with pytest.raises(ValueError, match="sample_n"):
+        bad.restore_snapshot(snap)
+
+
+def test_ledger_bounds_spans_and_counts_drops():
+    led = SpanLedger(sample_n=1, max_spans=4)
+    for i in range(8):
+        led.stamp(content_hash(0, i, b"flood %d" % i), "ring_accept")
+    assert led.n_spans <= 4
+    assert led.dropped_spans == 4        # loud, never silent
+
+
+def test_chrome_and_otlp_exports_are_shaped():
+    led = SpanLedger(sample_n=1, clock=_FakeClock())
+    key = content_hash(1, 7, b"export me")
+    for stage in STAGES:
+        led.stamp(key, stage)
+    led.close(key)
+    doc = led.export_chrome_trace()
+    assert doc["displayTimeUnit"] == "ms"
+    evs = doc["traceEvents"]
+    assert any(e["ph"] == "M" for e in evs)          # thread names
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert xs and all(e["dur"] >= 0 and e["ts"] >= 0 for e in xs)
+    json.dumps(doc)                                   # serializable
+    otlp = led.export_otlp()
+    spans = otlp["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert len(spans) == 1
+    sp = spans[0]
+    assert len(sp["traceId"]) == 32 and len(sp["spanId"]) == 16
+    assert int(sp["endTimeUnixNano"]) >= int(sp["startTimeUnixNano"])
+
+
+# ---------------------------------------------------------------------------
+# prometheus exposition: golden text (satellite 2)
+# ---------------------------------------------------------------------------
+
+
+def test_render_prometheus_golden_text():
+    reg = MetricsRegistry()
+    reg.describe("serve.ingest.accepted",
+                 'messages admitted\nby the ring "door"')
+    reg.inc("serve.ingest.accepted", 3)
+    reg.inc("serve.ingest.shed", 1,
+            labels={"topic": "1", "why": 'depth "high"\nback\\slash'})
+    reg.inc("serve.ingest.shed", 2, labels={"topic": "0", "why": "priority"})
+    reg.gauge("serve.watchdog.tier", 2)
+    reg.gauge("gossip.delivery-frac", 0.5)
+    assert reg.render_prometheus() == (
+        '# HELP serve_ingest_accepted_total messages admitted\\nby the '
+        'ring "door"\n'
+        '# TYPE serve_ingest_accepted_total counter\n'
+        'serve_ingest_accepted_total 3\n'
+        '# HELP serve_ingest_shed_total serve.ingest.shed\n'
+        '# TYPE serve_ingest_shed_total counter\n'
+        'serve_ingest_shed_total{topic="0",why="priority"} 2\n'
+        'serve_ingest_shed_total{topic="1",why="depth \\"high\\"\\n'
+        'back\\\\slash"} 1\n'
+        '# HELP gossip_delivery_frac gossip.delivery-frac\n'
+        '# TYPE gossip_delivery_frac gauge\n'
+        'gossip_delivery_frac 0.5\n'
+        '# HELP serve_watchdog_tier serve.watchdog.tier\n'
+        '# TYPE serve_watchdog_tier gauge\n'
+        'serve_watchdog_tier 2\n'
+    )
+
+
+# ---------------------------------------------------------------------------
+# black box + HTTP surface
+# ---------------------------------------------------------------------------
+
+
+def test_blackbox_bounded_ring_and_postmortem_dump(tmp_path):
+    box = BlackBox(capacity=4, clock=_FakeClock())
+    for i in range(10):
+        box.record({"chunk": i, "queue_depth": i % 3})
+    assert len(box) == 4 and box.recorded == 10
+    assert [f["chunk"] for f in box.frames()] == [6, 7, 8, 9]
+    path = str(tmp_path / "post.json")
+    box.dump(path, extra={"reason": "test"})
+    doc = json.load(open(path))
+    assert doc["format"] == "obs-blackbox/1"
+    assert doc["recorded"] == 10 and len(doc["frames"]) == 4
+    assert doc["extra"]["reason"] == "test"
+    assert all("t" in f for f in doc["frames"])
+
+
+def test_obs_http_server_metrics_and_debug():
+    from urllib.request import urlopen
+    from urllib.error import HTTPError
+
+    reg = MetricsRegistry()
+    reg.inc("serve.engine.chunks", 5)
+    led = SpanLedger(sample_n=1)
+    led.stamp(content_hash(0, 0, b"x"), "ring_accept")
+    box = BlackBox(capacity=4)
+    box.record({"chunk": 0})
+    srv = ObsHTTPServer(reg, ledger=led, blackbox=box)
+    port = srv.start()
+    try:
+        with urlopen(f"http://127.0.0.1:{port}/metrics") as r:
+            assert r.headers["Content-Type"].startswith(
+                "text/plain; version=0.0.4")
+            body = r.read().decode()
+        assert "serve_engine_chunks_total 5" in body
+        with urlopen(f"http://127.0.0.1:{port}/debug/obs") as r:
+            dbg = json.loads(r.read().decode())
+        assert dbg["spans"]["spans"] == 1
+        assert dbg["blackbox"]["recorded"] == 1
+        assert len(dbg["blackbox"]["frames"]) == 1
+        with pytest.raises(HTTPError):
+            urlopen(f"http://127.0.0.1:{port}/nope")
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# traced vs untraced: bit-identity, exact quantiles, artifact shape
+# ---------------------------------------------------------------------------
+
+# Host wall-clock channels legitimately differ between two runs; every
+# OTHER channel/counter must agree leaf-for-leaf with tracing on vs off.
+_WALL_CLOCK_CHANNELS = {"ingest_lat_p50_s", "ingest_lat_p99_s",
+                        "ingest_lat_max_s", "recovery_s"}
+_WALL_CLOCK_STATS = {"recovery_s_list", "recovery_gap_s", "trace_out",
+                     "trace_summary", "seconds", "pipeline"}
+
+
+@pytest.fixture(scope="module")
+def traced_pair(tmp_path_factory):
+    """One untraced + one traced run of the same clean tiny scenario (the
+    rollout compiles once, shared across both via the model-keyed cache)."""
+    out = str(tmp_path_factory.mktemp("obs") / "trace.json")
+    spec = _tiny_spec()
+    plain = scenario.run_streaming_scenario(spec)
+    traced = scenario.run_streaming_scenario(spec, trace_out=out)
+    return plain, traced, out
+
+
+def test_tracing_off_is_bit_identical(traced_pair):
+    plain, traced, _ = traced_pair
+    assert plain.verdict.passed and traced.verdict.passed
+    for name in sorted(set(plain.record) | set(traced.record)):
+        if name in _WALL_CLOCK_CHANNELS:
+            continue
+        a, b = plain.record[name], traced.record[name]
+        np.testing.assert_array_equal(
+            a, b, err_msg=f"channel {name} differs with tracing on")
+    for key in sorted(set(plain.engine_stats) | set(traced.engine_stats)):
+        if key in _WALL_CLOCK_STATS:
+            continue
+        assert plain.engine_stats[key] == traced.engine_stats[key], (
+            f"engine stat {key}: {plain.engine_stats[key]} != "
+            f"{traced.engine_stats[key]} with tracing on")
+    assert traced.engine_stats["compile_cache_size"] == 1
+
+
+def test_span_artifact_shape_and_full_closure(traced_pair):
+    _, traced, out = traced_pair
+    art = json.load(open(out))
+    assert art["format"] == "obs-span-artifact/1"
+    assert art["plane"] == "streaming"
+    s = art["summary"]
+    assert s["spans"] > 0
+    assert s["open"] == 0, "clean drain left spans open"
+    assert s["closed"] == s["spans"]
+    assert s["duplicate_closes"] == 0
+    # every span touched every lifecycle stage on this clean run
+    for span in art["spans"]:
+        stages = [st["stage"] for st in span["stamps"]]
+        assert set(STAGES) <= set(stages), stages
+    assert len(art["otlp"]["resourceSpans"][0]["scopeSpans"][0]["spans"]) \
+        == s["spans"]
+    assert art["chrome_trace"]["traceEvents"]
+    assert "metrics_prometheus" in art and "blackbox" in art
+
+
+def test_exact_quantiles_bounded_by_chunk_quantiles(traced_pair):
+    _, traced, out = traced_pair
+    art = json.load(open(out))
+    lat = art["latency"]
+    assert np.isfinite(lat["exact"]["p50"])
+    # span-derived exact latency is elementwise <= chunk-quantized latency
+    # by construction, so the quantiles are ordered deterministically
+    assert lat["exact"]["p50"] <= lat["chunk"]["p50"] + 1e-12
+    assert lat["exact"]["p99"] <= lat["chunk"]["p99"] + 1e-12
+    # the artifact's chunk quantiles are the very numbers the runner graded
+    assert lat["chunk"]["p50"] == traced.record["ingest_lat_p50_s"][-1]
+    assert lat["chunk"]["p99"] == traced.record["ingest_lat_p99_s"][-1]
+
+
+def test_trace_view_json_smoke(traced_pair):
+    _, _, out = traced_pair
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_view.py"),
+         out, "--json"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+    doc = json.loads(r.stdout)
+    assert doc["format"] == "obs-span-artifact/1"
+    assert doc["open"] == 0 and doc["passed"] is True
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_view.py"),
+         out],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r2.returncode == 0 and "span artifact" in r2.stdout
+
+
+def test_trace_view_rejects_unknown_format(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text("{}")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_view.py"),
+         str(bad)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 2
+    assert "unknown artifact format" in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# sim-plane record artifact through the runner + CLI
+# ---------------------------------------------------------------------------
+
+
+def test_sim_runner_trace_out(tmp_path):
+    out = str(tmp_path / "sim.json")
+    spec = scenario.ScenarioSpec(
+        name="tiny_obs_sim", family="gossipsub", n_steps=8, seed=3,
+        model=dict(n_peers=16, n_slots=8, conn_degree=4, msg_window=16,
+                   heartbeat_steps=4),
+        workloads=[scenario.Workload(kind="burst", topic=0, start=1,
+                                     n_msgs=2)],
+        slo=scenario.SLO(min_delivery_frac=0.0),
+    )
+    res = scenario.run_scenario(spec, trace_out=out)
+    art = json.load(open(out))
+    assert art["format"] == "obs-record-trace/1"
+    assert art["plane"] == "sim" and art["time_axis"] == "steps"
+    assert art["verdict"]["passed"] == res.verdict.passed
+    assert set(art["channels"])    # flight channels made it across
+    for name, ch in art["channels"].items():
+        assert ch["len"] == len(res.record[name])
+    counter_evs = [e for e in art["chrome_trace"]["traceEvents"]
+                   if e["ph"] == "C"]
+    assert counter_evs
+
+
+# ---------------------------------------------------------------------------
+# perf_diff: pre-r18 records warn, never crash (satellite 5)
+# ---------------------------------------------------------------------------
+
+
+def test_perf_diff_warns_on_pre_r18_record(tmp_path):
+    """An r17 record has a streaming section but no 'obs' subsection —
+    diffing it against an r18 record must warn one-sidedly and exit 0."""
+    streaming_old = {"value": 900.0, "backend": "cpu", "n_peers": 4,
+                     "chunk_steps": 8}
+    old = {"metric": "m", "value": 100.0, "methodology_version": 2,
+           "backend": "cpu", "n_peers": 4, "streaming": streaming_old}
+    new = dict(old, streaming=dict(
+        streaming_old, value=910.0,
+        obs={"overhead_frac": 0.003, "traced_msgs_per_sec": 905.0,
+             "untraced_msgs_per_sec": 908.0,
+             "span_p50_s": 0.01, "span_p99_s": 0.02,
+             "chunk_p50_s": 0.012, "chunk_p99_s": 0.022},
+    ))
+    po, pn = tmp_path / "o.json", tmp_path / "n.json"
+    po.write_text(json.dumps(old))
+    pn.write_text(json.dumps(new))
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "perf_diff.py"),
+         str(po), str(pn)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "WARNING" in r.stdout
+    assert "obs" in r.stdout and "r18" in r.stdout

@@ -120,6 +120,21 @@ SCALAR_ROWS: List[Tuple[Tuple[str, ...], str, bool]] = [
      "streaming shed (priority)", False),
     (("streaming", "degraded", "dropped_oldest"),
      "streaming dropped (oldest)", False),
+    # Observability A/B subsection (r18+); warn-not-crash when a record
+    # predates it.  ``overhead_frac`` is the headline acceptance number
+    # (traced vs untraced throughput cost, budget <= 2%); the span rows are
+    # the ledger-derived exact latency quantiles next to their
+    # chunk-quantized counterparts.
+    (("streaming", "obs", "overhead_frac"),
+     "streaming obs overhead frac", False),
+    (("streaming", "obs", "traced_msgs_per_sec"),
+     "streaming traced msgs/sec", True),
+    (("streaming", "obs", "span_p50_s"),
+     "streaming span-exact p50 (s)", False),
+    (("streaming", "obs", "span_p99_s"),
+     "streaming span-exact p99 (s)", False),
+    (("streaming", "obs", "chunk_p50_s"),
+     "streaming chunk-quantized p50 (s)", False),
     # Adaptive coded gossip section (r16+); same warn-not-crash behavior
     # as sharded/rlnc/streaming when a record predates it.  The headline is
     # the crossover loss rate (lower = the adaptive plane starts winning
@@ -389,6 +404,15 @@ def context_warnings(old: Dict[str, Any], new: Dict[str, Any]) -> List[str]:
                     f"(missing in {which}; added in r14) — its rows are "
                     f"one-sided"
                 )
+        # Observability subsection (r18+): a pre-r18 record simply lacks
+        # the traced-vs-untraced A/B — warn, don't crash.
+        if ("obs" in to) != ("obs" in tn):
+            which = "old" if "obs" not in to else "new"
+            warns.append(
+                f"only one record has a streaming 'obs' subsection "
+                f"(missing in {which}; added in r18) — obs overhead/span "
+                f"rows are one-sided"
+            )
     # Adaptive coded gossip section (r16+): same treatment.
     ho, hn = old.get("hybrid"), new.get("hybrid")
     if (ho is None) != (hn is None):

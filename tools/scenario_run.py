@@ -76,6 +76,14 @@ def main(argv: List[str] | None = None) -> int:
                     "(repeatable)")
     ap.add_argument("--save-trace", metavar="PATH",
                     help="write the (single) run's replayable trace here")
+    ap.add_argument("--trace-out", metavar="PATH",
+                    help="write the (single) run's observability artifact "
+                    "here: per-message spans on the streaming plane, "
+                    "flight-record channel traces on sim/live; view with "
+                    "tools/trace_view.py or chrome://tracing")
+    ap.add_argument("--trace-sample", type=int, default=1, metavar="N",
+                    help="streaming plane: trace every Nth sampled message "
+                    "(deterministic on content hash; default 1 = all)")
     ap.add_argument("--json", action="store_true",
                     help="emit verdicts as JSON instead of the table")
     ap.add_argument("--plane", choices=("sim", "live", "streaming"),
@@ -151,6 +159,8 @@ def main(argv: List[str] | None = None) -> int:
         ap.error("--save-trace takes exactly one scenario")
     if plane != "sim" and (args.save_trace or args.replay):
         ap.error("--save-trace/--replay are sim-plane features")
+    if args.trace_out and len(specs) != 1:
+        ap.error("--trace-out takes exactly one scenario")
 
     if plane == "live" and not args.names and not args.spec:
         # Default canon sweep: keep only what the live plane can lower
@@ -188,18 +198,22 @@ def main(argv: List[str] | None = None) -> int:
                     n_hosts=args.live_hosts,
                     step_s=(args.live_step_ms / 1e3
                             if args.live_step_ms is not None else None),
+                    trace_out=args.trace_out,
                 )
             except scenario.LivePlaneError as e:
                 print(f"error: {e}", file=sys.stderr)
                 return 2
         elif plane == "streaming":
             try:
-                res = scenario.run_streaming_scenario(spec)
+                res = scenario.run_streaming_scenario(
+                    spec, trace_out=args.trace_out,
+                    trace_sample=args.trace_sample,
+                )
             except scenario.StreamingPlaneError as e:
                 print(f"error: {e}", file=sys.stderr)
                 return 2
         else:
-            res = scenario.run_scenario(spec)
+            res = scenario.run_scenario(spec, trace_out=args.trace_out)
         res.seconds = round(time.time() - t0, 3)
         results.append(res)
 

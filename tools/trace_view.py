@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""Summarize a ``--trace-out`` observability artifact.
+
+Usage::
+
+    python tools/trace_view.py trace.json
+    python tools/trace_view.py trace.json --json     # machine-readable
+    python tools/trace_view.py crash.postmortem.json # black-box dump
+
+Switches on the artifact's ``format`` key:
+
+- ``obs-span-artifact/1``  — streaming-plane span ledger: span counts,
+  stage-transition latency quantiles, events (watchdog tiers, restarts,
+  crash-recovery gaps), verdict, and the embedded latency comparison;
+- ``obs-record-trace/1``   — sim/live flight-record trace: per-channel
+  stats + verdict;
+- ``obs-blackbox/1``       — watchdog post-mortem: the last-K per-chunk
+  frames leading up to an engine restart.
+
+The artifact itself is self-contained — its ``chrome_trace`` member loads
+directly in ``chrome://tracing`` / Perfetto; this tool is the terminal
+view.  Exit 2 on an unreadable file or unknown format (infrastructure
+error, distinct from anything the run itself did).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List
+
+
+def _fmt_s(v: Any) -> str:
+    try:
+        return f"{float(v) * 1e3:.3f}ms"
+    except (TypeError, ValueError):
+        return str(v)
+
+
+def _span_summary(doc: Dict[str, Any]) -> Dict[str, Any]:
+    s = doc.get("summary", {})
+    gaps: List[float] = []
+    for span in doc.get("spans", []):
+        for ev in span.get("events", []):
+            if ev.get("name") == "crash_recovery" and "gap_s" in ev:
+                gaps.append(float(ev["gap_s"]))
+    out = {
+        "format": doc["format"],
+        "plane": doc.get("plane"),
+        "scenario": doc.get("scenario"),
+        "passed": doc.get("verdict", {}).get("passed"),
+        "sample_n": s.get("sample_n"),
+        "spans": s.get("spans"),
+        "open": s.get("open"),
+        "closed": s.get("closed"),
+        "dropped_spans": s.get("dropped_spans"),
+        "duplicate_closes": s.get("duplicate_closes"),
+        "transitions": s.get("transitions", {}),
+        "events": s.get("events", {}),
+        "spans_with_recovery_gap": len(gaps),
+        "max_recovery_gap_s": max(gaps) if gaps else None,
+        "chrome_events": len(
+            doc.get("chrome_trace", {}).get("traceEvents", [])),
+    }
+    for key in ("recovery_s", "recovery_gap_s", "chunk_wall_s", "latency"):
+        if key in doc:
+            out[key] = doc[key]
+    return out
+
+
+def _print_span(out: Dict[str, Any]) -> None:
+    print(f"span artifact  {out['scenario']}  plane={out['plane']}  "
+          f"{'PASS' if out['passed'] else 'FAIL'}")
+    print(f"  spans: {out['spans']} (open {out['open']}, closed "
+          f"{out['closed']}, dropped {out['dropped_spans']}, dup-closes "
+          f"{out['duplicate_closes']}, 1/{out['sample_n']} sampled)")
+    for name in sorted(out["transitions"]):
+        t = out["transitions"][name]
+        print(f"  {name:34s} n={t['count']:<5d} p50={_fmt_s(t['p50'])} "
+              f"p99={_fmt_s(t['p99'])}")
+    if out["events"]:
+        evs = ", ".join(f"{k}x{v}" for k, v in sorted(out["events"].items()))
+        print(f"  events: {evs}")
+    if out["spans_with_recovery_gap"]:
+        print(f"  crash-recovery gap on {out['spans_with_recovery_gap']} "
+              f"spans (max {_fmt_s(out['max_recovery_gap_s'])}; runner "
+              f"recovery_s {_fmt_s(out.get('recovery_s'))})")
+    lat = out.get("latency")
+    if isinstance(lat, dict):
+        for mode in ("chunk", "exact"):
+            q = lat.get(mode)
+            if q:
+                qs = "  ".join(f"{k}={_fmt_s(v)}" for k, v in sorted(
+                    q.items()))
+                print(f"  latency[{mode}]: {qs}")
+    print(f"  chrome_trace: {out['chrome_events']} events "
+          f"(load the artifact in chrome://tracing)")
+
+
+def _record_summary(doc: Dict[str, Any]) -> Dict[str, Any]:
+    return {
+        "format": doc["format"],
+        "plane": doc.get("plane"),
+        "scenario": doc.get("scenario"),
+        "passed": doc.get("verdict", {}).get("passed"),
+        "time_axis": doc.get("time_axis"),
+        "channels": doc.get("channels", {}),
+        "chrome_events": len(
+            doc.get("chrome_trace", {}).get("traceEvents", [])),
+    }
+
+
+def _print_record(out: Dict[str, Any]) -> None:
+    print(f"record trace  {out['scenario']}  plane={out['plane']}  "
+          f"{'PASS' if out['passed'] else 'FAIL'}  "
+          f"(time axis: {out['time_axis']})")
+    for name in sorted(out["channels"]):
+        c = out["channels"][name]
+        print(f"  {name:28s} len={c['len']:<5d} min={c['min']:.4g} "
+              f"mean={c['mean']:.4g} max={c['max']:.4g} last={c['last']:.4g}")
+    print(f"  chrome_trace: {out['chrome_events']} counter events")
+
+
+def _blackbox_summary(doc: Dict[str, Any]) -> Dict[str, Any]:
+    return {
+        "format": doc["format"],
+        "recorded": doc.get("recorded"),
+        "capacity": doc.get("capacity"),
+        "frames": len(doc.get("frames", [])),
+        "extra": doc.get("extra"),
+        "last_frame": (doc.get("frames") or [None])[-1],
+    }
+
+
+def _print_blackbox(doc: Dict[str, Any], out: Dict[str, Any]) -> None:
+    extra = out.get("extra") or {}
+    print(f"black box  frames={out['frames']}/{out['capacity']}  "
+          f"recorded={out['recorded']}")
+    if extra:
+        print(f"  restart: tier={extra.get('tier')}  "
+              f"reason={extra.get('reason')}")
+    for fr in doc.get("frames", [])[-8:]:
+        print(f"  chunk={fr.get('chunk'):<4} step={fr.get('step'):<6} "
+              f"depth={fr.get('queue_depth'):<4} "
+              f"wall={_fmt_s(fr.get('chunk_wall_s'))} "
+              f"completed={fr.get('completed')} shed={fr.get('shed_priority')}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("artifact", help="path to a --trace-out JSON artifact")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as JSON")
+    args = ap.parse_args(argv)
+
+    try:
+        with open(args.artifact) as fh:
+            doc = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"error: cannot read {args.artifact}: {e}", file=sys.stderr)
+        return 2
+    fmt = doc.get("format") if isinstance(doc, dict) else None
+
+    if fmt == "obs-span-artifact/1":
+        out = _span_summary(doc)
+        print(json.dumps(out, indent=1, sort_keys=True)) if args.json \
+            else _print_span(out)
+    elif fmt == "obs-record-trace/1":
+        out = _record_summary(doc)
+        print(json.dumps(out, indent=1, sort_keys=True)) if args.json \
+            else _print_record(out)
+    elif fmt == "obs-blackbox/1":
+        out = _blackbox_summary(doc)
+        print(json.dumps(out, indent=1, sort_keys=True)) if args.json \
+            else _print_blackbox(doc, out)
+    else:
+        print(f"error: unknown artifact format {fmt!r} "
+              f"(expected obs-span-artifact/1, obs-record-trace/1, or "
+              f"obs-blackbox/1)", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
