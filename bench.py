@@ -126,6 +126,22 @@ STREAMING_SCALE = dict(n_topics=2, n_peers=256, n_slots=16, degree=8,
                        completion_frac=0.99)
 STREAMING_RUN_TIMEOUT_S = 900.0
 
+# Live-plane cross-host tracing A/B (BENCH_MODE=live_obs, r19): a 16-host
+# in-process socket tree delivers an identical publish window twice per
+# rep — once with tracing OFF (no ledgers anywhere) and once at the
+# PRODUCTION sampling rate (1/16 hash-mod, the config a deployment would
+# actually run; unsampled frames cost the origin one sha256 and every
+# other host an attribute check) — arms interleaved so scheduler drift
+# hits both sides alike.  The headline is the traced/untraced delivered
+# msgs/sec ratio (best-of-reps per arm, budget <= 2% overhead), and the
+# traced arm's per-host ledgers are merged into the end-to-end propagation
+# quantiles (obs.merge) carried in the record.  Pure host-side sockets —
+# no accelerator, so the child always runs on the CPU platform pin.
+LIVE_OBS_SCALE = dict(
+    n_hosts=16, n_msgs=192, reps=3, payload_bytes=64, trace_sample=16,
+)
+LIVE_OBS_RUN_TIMEOUT_S = 600.0
+
 PROBE_TIMEOUT_S = 180.0
 # The r3 TPU run took ~4.5 min, and the r5 child adds the device-kernel
 # scaling curve (4 compiled batch shapes) and the phase-breakdown compiles,
@@ -315,6 +331,20 @@ def _run_streaming_child(probe_ok: bool) -> dict:
     return {"error": " | ".join(a[:300] for a in attempts)}
 
 
+def _run_live_obs_child() -> dict:
+    """Run the BENCH_MODE=live_obs child (16-host traced-vs-untraced
+    delivery A/B + cross-host span merge).  The live plane is host-side
+    sockets — no accelerator path, so the child runs straight on the CPU
+    platform pin; failure becomes an ``error`` dict, never a crash."""
+    parsed, tail = run_child(
+        {"BENCH_MODE": "live_obs", "JAX_PLATFORMS": "cpu"},
+        LIVE_OBS_RUN_TIMEOUT_S,
+    )
+    if parsed is not None:
+        return parsed
+    return {"error": f"live_obs attempt: {tail}"[:400]}
+
+
 def orchestrate() -> None:
     attempts = []
     record = None
@@ -376,6 +406,12 @@ def orchestrate() -> None:
     if os.environ.get("BENCH_STREAMING", "1") != "0":
         log("orchestrator: running streaming child (BENCH_MODE=streaming)")
         record["streaming"] = _run_streaming_child(probe_ok)
+
+    # Live-plane cross-host tracing A/B rides along the same way
+    # (tools/perf_diff.py diffs it; BENCH_LIVE_OBS=0 skips it).
+    if os.environ.get("BENCH_LIVE_OBS", "1") != "0":
+        log("orchestrator: running live_obs child (BENCH_MODE=live_obs)")
+        record["live_obs"] = _run_live_obs_child()
 
     print(json.dumps(record))
 
@@ -1695,6 +1731,165 @@ def streaming_child_main() -> None:
     print(json.dumps(record), flush=True)
 
 
+def live_obs_child_main() -> None:
+    """BENCH_MODE=live_obs: 16-host live-plane tracing A/B (ISSUE 16 r19).
+
+    Each rep runs the SAME publish window through two fresh in-process
+    socket trees — untraced (``trace_sample=None``: no ledger objects
+    exist, the r18-identical plane) then traced at the production
+    sampling rate (1/16 hash-mod: every host's ledger independently
+    agrees on the same traced subset; unsampled frames cost the origin
+    one sha256 and downstream hosts a ``traced``-flag check).  Arms
+    interleave so scheduler drift lands on both sides; the headline
+    compares best-of-reps delivered msgs/sec and asserts the <= 2%
+    overhead budget.  The best traced rep's per-host ledgers are merged
+    (obs.merge) and the end-to-end propagation quantiles ride the record
+    — the same numbers a traced canon run grades its latency SLO from.
+    """
+    import threading
+
+    from go_libp2p_pubsub_tpu.net.live import LiveNetwork
+    from go_libp2p_pubsub_tpu.obs.merge import (
+        build_host_span_artifact, merge_host_artifacts,
+    )
+    from go_libp2p_pubsub_tpu.obs.spans import SpanLedger, live_span_key
+
+    cfg = LIVE_OBS_SCALE
+    n_hosts = int(os.environ.get("BENCH_LIVE_OBS_HOSTS", cfg["n_hosts"]))
+    n_msgs = int(os.environ.get("BENCH_LIVE_OBS_MSGS", cfg["n_msgs"]))
+    reps = int(os.environ.get("BENCH_LIVE_OBS_REPS", cfg["reps"]))
+    sample_n = int(
+        os.environ.get("BENCH_LIVE_OBS_SAMPLE", cfg["trace_sample"])
+    )
+    pad = b"x" * cfg["payload_bytes"]
+    n_subs = n_hosts - 1
+    # Host ids derive from a per-network counter, so the hash-sampled
+    # subset is identical in every rep; the arm reports it so the merge
+    # assertions below check exact coverage, not a statistical bound.
+    n_traced_expected = [None]
+
+    def live_arm(traced: bool):
+        """One delivery run; returns (msgs/sec, deliveries, artifacts)."""
+        net = LiveNetwork(trace_sample=sample_n if traced else None)
+        try:
+            hosts = net.make_hosts(n_hosts)
+            topic = hosts[0].new_topic("bench")
+            if traced and n_traced_expected[0] is None:
+                probe = SpanLedger(sample_n=sample_n)
+                protoid = f"{hosts[0].id}/bench"
+                n_traced_expected[0] = sum(
+                    probe.sampled(
+                        live_span_key(protoid, b"bench:%d:" % i + pad)
+                    )
+                    for i in range(n_msgs)
+                )
+            subs = [h.subscribe(hosts[0].id, "bench") for h in hosts[1:]]
+            time.sleep(0.3)  # let the join fan-out settle off the clock
+            counts = [0] * n_subs
+
+            def drain(i, sub):
+                while counts[i] < n_msgs:
+                    try:
+                        sub.get(timeout=5.0)
+                    except Exception:
+                        return
+                    counts[i] += 1
+
+            threads = [
+                threading.Thread(target=drain, args=(i, s), daemon=True)
+                for i, s in enumerate(subs)
+            ]
+            for th in threads:
+                th.start()
+            t0 = time.perf_counter()
+            for i in range(n_msgs):
+                topic.publish_message(b"bench:%d:" % i + pad)
+            for th in threads:
+                th.join(timeout=30.0)
+            elapsed = time.perf_counter() - t0
+            delivered = sum(counts)
+            arts = None
+            if traced:
+                arts = [
+                    build_host_span_artifact(h.id, h.ledger)
+                    for h in hosts if h.ledger is not None
+                ]
+            return delivered / elapsed, delivered, arts
+        finally:
+            net.shutdown()
+
+    expect = n_msgs * n_subs
+    traced_rates, untraced_rates = [], []
+    best_arts = None
+    for rep in range(reps):
+        r_plain, d_plain, _ = live_arm(False)
+        r_traced, d_traced, arts = live_arm(True)
+        assert d_plain == expect, \
+            f"untraced rep {rep} delivered {d_plain}/{expect}"
+        assert d_traced == expect, \
+            f"traced rep {rep} delivered {d_traced}/{expect}"
+        untraced_rates.append(r_plain)
+        traced_rates.append(r_traced)
+        if r_traced == max(traced_rates):
+            best_arts = arts
+        log(f"live_obs rep {rep}: untraced {r_plain:,.0f} msgs/s  "
+            f"traced {r_traced:,.0f} msgs/s")
+
+    best_plain = max(untraced_rates)
+    best_traced = max(traced_rates)
+    overhead = max(0.0, 1.0 - best_traced / best_plain)
+    merged = merge_host_artifacts(best_arts)
+    prop = merged["propagation"]
+    n_traced = n_traced_expected[0]
+    log(f"live_obs: untraced {best_plain:,.0f} msgs/s  traced "
+        f"{best_traced:,.0f} msgs/s  overhead {overhead*100:.2f}%  "
+        f"merged {prop['messages']}/{n_traced} sampled msgs / "
+        f"{prop['deliveries']} deliveries  "
+        f"prop p50 {prop['p50_s']*1e3:.2f}ms p99 {prop['p99_s']*1e3:.2f}ms")
+    assert n_traced and n_traced > 0, \
+        f"hash sampling at 1/{sample_n} traced none of {n_msgs} payloads"
+    assert prop["messages"] == n_traced, \
+        f"merge saw {prop['messages']} traced messages, expected {n_traced}"
+    assert prop["deliveries"] == n_traced * n_subs, \
+        (f"merge saw {prop['deliveries']} deliveries, "
+         f"expected {n_traced * n_subs}")
+    assert overhead <= 0.02, \
+        f"live tracing overhead {overhead*100:.2f}% above the 2% budget"
+
+    record = {
+        "metric": "live_traced_delivered_msgs_per_sec",
+        "value": round(best_traced, 1),
+        "unit": "msgs/sec",
+        "n_hosts": n_hosts,
+        "trace_sample": sample_n,
+        "reps": reps,
+        "msgs_per_rep": n_msgs,
+        "traced_msgs_per_rep": n_traced,
+        "payload_bytes": cfg["payload_bytes"],
+        "untraced_msgs_per_sec": round(best_plain, 1),
+        "traced_msgs_per_sec": round(best_traced, 1),
+        "overhead_frac": round(overhead, 5),
+        "overhead_budget_frac": 0.02,
+        "merged_messages": prop["messages"],
+        "merged_deliveries": prop["deliveries"],
+        "merged_prop_p50_s": round(float(prop["p50_s"]), 6),
+        "merged_prop_p99_s": round(float(prop["p99_s"]), 6),
+        "merged_hosts": len(merged["hosts"]),
+        "per_hop": {
+            name: {"count": h["count"], "p50": round(float(h["p50"]), 6),
+                   "p99": round(float(h["p99"]), 6)}
+            for name, h in prop["per_hop"].items()
+        },
+        "note": (
+            "interleaved A/B over fresh 16-host socket trees; best-of-reps "
+            "delivered msgs/sec; traced arm samples 1/N by content hash "
+            "(the production rate); merged propagation is span-exact origin "
+            "publish -> subscriber deliver across per-host ledgers"
+        ),
+    }
+    print(json.dumps(record), flush=True)
+
+
 def child_main() -> None:
     mode = os.environ.get("BENCH_MODE", "tpu")
     if mode == "sharded":
@@ -1705,6 +1900,8 @@ def child_main() -> None:
         return hybrid_child_main()
     if mode == "streaming":
         return streaming_child_main()
+    if mode == "live_obs":
+        return live_obs_child_main()
     scale = TPU_SCALE if mode == "tpu" else CPU_SCALE
 
     import jax

@@ -50,6 +50,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from ..config import SUB_REPAIR_TIMEOUT_S, DELIVERY_BUFFER, RetryOpts, TreeOpts
 from ..crypto.pipeline import Envelope, ValidationPipeline, sign_envelope
+from ..obs.spans import SpanLedger, live_span_key
 from ..utils.log import get_logger, kv
 from ..utils.metrics import MetricsRegistry
 from ..wire import Message, MessageType
@@ -176,6 +177,7 @@ class _BatchValidator:
                     continue
                 self.last_seqno = env.seqno
                 await self.sub.out.put(env.payload)
+                self.sub.node.trace_stamp(m, "deliver", seqno=env.seqno)
                 await self.sub.node.forward_message(m)
 
 
@@ -229,6 +231,7 @@ class _TreeNode:
         repair_timeout_s: float = SUB_REPAIR_TIMEOUT_S,
         metrics: Optional[MetricsRegistry] = None,
         retry: Optional[RetryPolicy] = None,
+        ledger: Optional[SpanLedger] = None,
     ) -> None:
         self.host = host
         self.protoid = protoid
@@ -236,6 +239,10 @@ class _TreeNode:
         self.max_width = opts.tree_max_width
         self.repair_timeout_s = repair_timeout_s
         self.metrics = metrics  # shared registry (the /metrics counters)
+        # Per-host span ledger (r19 distributed tracing, obs/merge.py).
+        # None means tracing off: every stamp site below is guarded so the
+        # untraced plane stays bit- and counter-identical to r18.
+        self.ledger = ledger
         # Every dial-shaped operation (subscribe dial, join-walk hops,
         # adoption dials, rejoin-at-root) runs under this policy; shared per
         # topic manager so breaker state is per (host, operation class).
@@ -277,6 +284,24 @@ class _TreeNode:
     def _inc(self, name: str, value: float = 1.0) -> None:
         if self.metrics is not None:
             self.metrics.inc(name, value)
+
+    def trace_stamp(self, m: Message, stage: str, **attrs) -> None:
+        """Hop-level span stamp for a traced Data frame.  The key is
+        computed from (protoid, payload) — identical on every host the
+        frame crosses, so per-host ledgers line up with no id exchange —
+        and memoized on the frame: a host stamps the same Message object
+        at recv, deliver, and forward, and the sha256 runs on the shared
+        event-loop thread, so one hash per frame per host matters.
+        A no-op unless tracing is on AND the origin marked the frame."""
+        if self.ledger is None or not m.traced:
+            return
+        key = m.span_key
+        if key is None:
+            key = live_span_key(self.protoid, m.data)
+            m.span_key = key
+        self.ledger.stamp(
+            key, stage, **attrs
+        )
 
     async def dial_retry(self, peer_id: str, cls: str = "dial",
                          max_attempts: Optional[int] = None) -> Stream:
@@ -665,6 +690,7 @@ class _TreeNode:
             if since <= i < child.admitted_fwd_idx
         ]
         for m in pending:
+            self.trace_stamp(m, "replay_send", to=cid)
             try:
                 await child.stream.write_message(m)
             except StreamClosed:
@@ -698,6 +724,8 @@ class _TreeNode:
         targets = [(cid, c) for cid, c in self.children.items() if not c.dead]
         if not targets:
             return
+        if m.type == MessageType.DATA:
+            self.trace_stamp(m, "send", fanout=len(targets))
 
         async def send(c: _Child):
             await c.stream.write_message(m)
@@ -842,7 +870,8 @@ class LiveTopic:
         self.title = title
         self.protoid = f"{tm.host.id}/{title}"  # (root, title) namespacing
         self.node = _TreeNode(
-            tm.host, self.protoid, opts, metrics=tm.registry, retry=tm.retry
+            tm.host, self.protoid, opts, metrics=tm.registry, retry=tm.retry,
+            ledger=tm.ledger,
         )
         self.node.is_root = True
         # Publisher identity: with a seed, every publish travels as a signed
@@ -897,11 +926,29 @@ class LiveTopic:
             "publish",
             extra=kv(topic=self.title, root=self.tm.host.id, bytes=len(data)),
         )
+        # Distributed tracing (r19): the ORIGIN decides whether this message
+        # is traced — the same deterministic hash-mod sampling every host's
+        # ledger applies — and marks the frame so downstream hosts stamp hop
+        # spans without rehashing untraced traffic.  The frame also carries
+        # this host's clock-offset estimate for the cross-host merge.
+        traced, clock_off = False, 0.0
+        if self.tm.ledger is not None:
+            key = live_span_key(self.protoid, data)
+            if self.tm.ledger.sampled(key):
+                traced = True
+                clock_off = self.tm.trace_clock_offset
+                self.tm.ledger.stamp(
+                    key, "publish", bytes=len(data), epoch=self.node.epoch,
+                )
         # Data carries the current epoch (omitted at 0): post-failover
         # receivers fence out anything a deposed root keeps publishing.
-        await self.node.forward_message(Message(
+        m = Message(
             type=MessageType.DATA, data=data, epoch=self.node.epoch,
-        ))
+            traced=traced, clock_offset=clock_off,
+        )
+        if traced:
+            m.span_key = key
+        await self.node.forward_message(m)
 
     async def close(self) -> None:
         """Reference-parity close (``pubsub.go:99-103``): unregister only;
@@ -937,6 +984,7 @@ class LiveSubscription:
             repair_timeout_s=repair_timeout_s,
             metrics=tm.registry,
             retry=tm.retry,
+            ledger=tm.ledger,
         )
         self.node.root_id = root_id
         # Successors checkpoint too (they may be promoted): a restarted
@@ -1035,12 +1083,21 @@ class LiveSubscription:
         while not node.closed:
             if node.parent_stream is None:
                 return  # promoted to root: the server-side handlers take over
+            sender = node.parent_stream.remote_peer
             try:
                 m = await node.parent_stream.read_message()
             except StreamClosed:
                 if node.closed:
                     return
                 node.parent_stream = None
+                if node.ledger is not None:
+                    # Cross-host failover forensics: when this parent death
+                    # turns out to be a root kill, the merge pairs the
+                    # earliest parent_lost with the promotion to draw the
+                    # recovery gap across the hosts that observed it.
+                    node.ledger.event(
+                        "parent_lost", parent=sender, epoch=node.epoch,
+                    )
                 try:
                     # Typed wait: a timeout lands in the registry as
                     # live.retry.repair.timeout before the rejoin fallback.
@@ -1070,6 +1127,10 @@ class LiveSubscription:
                 # neither delivered, relayed, nor validated.
                 if not node.fence_frame(m):
                     continue
+                node.trace_stamp(
+                    m, "recv", replay=m.replay, epoch=m.epoch,
+                    origin_offset=m.clock_offset, **{"from": sender},
+                )
                 if self.validator is not None:
                     # Verdict-gated path: the batch validator delivers and
                     # relays (in arrival order) only what verifies (its
@@ -1083,6 +1144,7 @@ class LiveSubscription:
                     node._inc("live.dup_suppressed")
                     continue
                 await self.out.put(m.data)        # deliver (client.go:124-127)
+                node.trace_stamp(m, "deliver")
                 await node.forward_message(m)     # then relay (client.go:130)
             elif m.type == MessageType.UPDATE:
                 # Mid-stream Update: the failover piggyback channel — the
@@ -1160,6 +1222,7 @@ class LiveSubscription:
                 if node.degraded:
                     node.degraded = False
                     node._inc("live.failover.unparked")
+                    self._trace_failover_merged("rejoined_successor")
                 node._inc("live.failover.rejoined_successor")
                 _log.info(
                     "failover_rejoined",
@@ -1182,6 +1245,7 @@ class LiveSubscription:
                 node.parent_stream = ns
                 if node.degraded:
                     node.degraded = False
+                    self._trace_failover_merged("adopted")
                 node._inc("live.failover.adopted")
                 _log.info(
                     "failover_adopted", extra=kv(peer=me, epoch=node.epoch)
@@ -1202,6 +1266,18 @@ class LiveSubscription:
             if not node.degraded:
                 node.degraded = True
                 node._inc("live.failover.parked")
+                if node.ledger is not None:
+                    # Park opens the cross-host failover window: the merge
+                    # draws the gap from here to the matching merge/heal
+                    # event, and every in-flight traced message on this
+                    # host carries the annotation.
+                    node.ledger.event(
+                        "failover_parked", epoch=node.epoch,
+                        rank=-1 if rank is None else rank,
+                    )
+                    node.ledger.annotate_open(
+                        "failover_park", epoch=node.epoch,
+                    )
                 _log.info(
                     "failover_parked",
                     extra=kv(peer=me, epoch=node.epoch, rank=rank),
@@ -1214,10 +1290,21 @@ class LiveSubscription:
                 node.parent_stream = ns
                 node.degraded = False
                 node._inc("live.failover.unparked")
+                self._trace_failover_merged("adopted_while_parked")
                 return True
             if await self._probe_root_once():
                 return True
         return False
+
+    def _trace_failover_merged(self, how: str) -> None:
+        """Close the failover window on this host's ledger: the parked
+        (degraded read-only) side rejoined a live regime."""
+        node = self.node
+        if node.ledger is not None:
+            node.ledger.event(
+                "failover_merged", how=how, epoch=node.epoch,
+            )
+            node.ledger.annotate_open("failover_merge", epoch=node.epoch)
 
     async def _probe_root_once(self) -> bool:
         """One cheap rejoin attempt at the original root (park loop): the
@@ -1237,6 +1324,7 @@ class LiveSubscription:
         if node.degraded:
             node.degraded = False
             node._inc("live.failover.unparked")
+            self._trace_failover_merged("healed")
         _log.info(
             "failover_healed",
             extra=kv(peer=self.tm.host.id, root=node.root_id, epoch=node.epoch),
@@ -1291,6 +1379,11 @@ class LiveSubscription:
         node.degraded = False
         node.parent_stream = None
         node._inc("live.failover.promoted")
+        if node.ledger is not None:
+            # Promotion closes the recovery window the earliest parent_lost
+            # opened — the merged Chrome trace renders the pair as one
+            # annotated gap, graded against the runner's heal_s.
+            node.ledger.event("promoted", epoch=node.epoch)
         orphans = [x for x in node.successors if x != me]
         _log.info(
             "promoted",
@@ -1323,10 +1416,25 @@ class LiveSubscription:
             )
         self._remember(hashlib.sha256(data).digest())
         node._inc("live.msgs_published")
-        await self.out.put(data)  # self-delivery: I am still a subscriber
-        await node.forward_message(Message(
+        traced, clock_off = False, 0.0
+        if self.tm.ledger is not None:
+            key = live_span_key(self.protoid, data)
+            if self.tm.ledger.sampled(key):
+                traced = True
+                clock_off = self.tm.trace_clock_offset
+                self.tm.ledger.stamp(
+                    key, "publish", bytes=len(data), epoch=node.epoch,
+                    promoted=True,
+                )
+        m = Message(
             type=MessageType.DATA, data=data, epoch=node.epoch,
-        ))
+            traced=traced, clock_offset=clock_off,
+        )
+        if traced:
+            m.span_key = key
+        await self.out.put(data)  # self-delivery: I am still a subscriber
+        node.trace_stamp(m, "deliver")
+        await node.forward_message(m)
 
     async def close(self) -> None:
         """Graceful leave (``client.Close``, ``client.go:30-34``)."""
@@ -1353,10 +1461,18 @@ class LiveTopicManager:
         repair_timeout_s: float = SUB_REPAIR_TIMEOUT_S,
         registry: Optional[MetricsRegistry] = None,
         retry_opts: Optional[RetryOpts] = None,
+        ledger: Optional[SpanLedger] = None,
+        trace_clock_offset: float = 0.0,
     ):
         self.host = host
         self.repair_timeout_s = repair_timeout_s
         self.registry = registry
+        # r19 cross-host tracing: the host's span ledger (None = tracing
+        # off) and its host-clock offset estimate relative to the cluster
+        # reference clock.  The offset rides traced frames so the merge can
+        # normalize skewed timestamps without any clock-sync protocol.
+        self.ledger = ledger
+        self.trace_clock_offset = trace_clock_offset
         # One policy per host: breaker state is this host's view of each
         # operation class (dial/join/adopt/rejoin).
         self.retry = RetryPolicy(retry_opts, registry=registry)
@@ -1393,114 +1509,43 @@ class LiveTopicManager:
 # ---------------------------------------------------------------------------
 
 
-class MetricsHTTPServer:
-    """Minimal asyncio HTTP/1.0 server exposing the live plane's telemetry.
-
-    - ``GET /metrics``     Prometheus text exposition of the shared
-      :class:`MetricsRegistry` (counters from the protocol sites above plus
-      whatever gauges the host recorded, e.g. ``observe_state`` snapshots of
-      a device sim riding alongside).
-    - ``GET /debug/tree``  JSON topology snapshot per registered topic
-      manager — the servable descendant of the reference's private
-      ``printTree`` debugger (``pubsub_test.go:204-229``): each topic's
-      children (with subtree sizes) and each subscription's current parent.
-
-    Request parsing is deliberately tiny (request line + drained headers):
-    the endpoint serves scrape loops and humans with curl, not general HTTP.
-    """
-
-    def __init__(
-        self,
-        registry: MetricsRegistry,
-        sources: Optional[Callable[[], Dict[str, LiveTopicManager]]] = None,
-        bind: str = "127.0.0.1",
-    ):
-        self.registry = registry
-        self._sources = sources or (lambda: {})
-        self._bind = bind
-        self._server: Optional[asyncio.AbstractServer] = None
-        self.port: Optional[int] = None
-
-    async def start(self) -> int:
-        self._server = await asyncio.start_server(self._handle, self._bind, 0)
-        self.port = self._server.sockets[0].getsockname()[1]
-        _log.info("metrics_listening", extra=kv(bind=self._bind, port=self.port))
-        return self.port
-
-    async def aclose(self) -> None:
-        if self._server is not None:
-            self._server.close()
-            self._server = None
-
-    def tree_snapshot(self) -> Dict[str, dict]:
-        snap: Dict[str, dict] = {}
-        for host_id, tm in self._sources().items():
-            topics = {
-                title: {
-                    "subtree_size": t.node.subtree_size(),
-                    "children": {
-                        cid: c.size
-                        for cid, c in t.node.children.items()
-                        if not c.dead
-                    },
-                }
-                for title, t in tm.topics.items()
+def tree_snapshot(sources: Dict[str, LiveTopicManager]) -> Dict[str, dict]:
+    """JSON topology snapshot per topic manager — the servable descendant
+    of the reference's private ``printTree`` debugger
+    (``pubsub_test.go:204-229``): each topic's children (with subtree
+    sizes) and each subscription's current parent.  Pure reads of
+    loop-owned state, so the obs server's handler thread may call it
+    without touching the event loop."""
+    snap: Dict[str, dict] = {}
+    for host_id, tm in sources.items():
+        topics = {
+            title: {
+                "subtree_size": t.node.subtree_size(),
+                "children": {
+                    cid: c.size
+                    for cid, c in t.node.children.items()
+                    if not c.dead
+                },
             }
-            subs = {}
-            for sub in tm.subscriptions:
-                ps = sub.node.parent_stream
-                subs[sub.protoid] = {
-                    "parent": (
-                        ps.remote_peer if ps is not None and not ps.closed
-                        else None
-                    ),
-                    "subtree_size": sub.node.subtree_size(),
-                    "children": {
-                        cid: c.size
-                        for cid, c in sub.node.children.items()
-                        if not c.dead
-                    },
-                }
-            snap[host_id] = {"topics": topics, "subscriptions": subs}
-        return snap
-
-    async def _handle(
-        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
-    ) -> None:
-        try:
-            request = await reader.readline()
-            parts = request.decode("ascii", errors="replace").split()
-            path = parts[1] if len(parts) >= 2 else "/"
-            while True:  # drain request headers
-                line = await reader.readline()
-                if line in (b"\r\n", b"\n", b""):
-                    break
-            if path == "/metrics":
-                status, ctype = "200 OK", "text/plain; version=0.0.4"
-                body = self.registry.render_prometheus().encode()
-            elif path == "/debug/tree":
-                status, ctype = "200 OK", "application/json"
-                body = json.dumps(self.tree_snapshot(), sort_keys=True).encode()
-            else:
-                status, ctype = "404 Not Found", "text/plain"
-                body = b"not found\n"
-            writer.write(
-                (
-                    f"HTTP/1.0 {status}\r\n"
-                    f"Content-Type: {ctype}\r\n"
-                    f"Content-Length: {len(body)}\r\n"
-                    f"Connection: close\r\n\r\n"
-                ).encode()
-                + body
-            )
-            await writer.drain()
-        except (ConnectionError, OSError):
-            pass
-        finally:
-            try:
-                writer.close()
-            except Exception:
-                pass
+            for title, t in tm.topics.items()
+        }
+        subs = {}
+        for sub in tm.subscriptions:
+            ps = sub.node.parent_stream
+            subs[sub.protoid] = {
+                "parent": (
+                    ps.remote_peer if ps is not None and not ps.closed
+                    else None
+                ),
+                "subtree_size": sub.node.subtree_size(),
+                "children": {
+                    cid: c.size
+                    for cid, c in sub.node.children.items()
+                    if not c.dead
+                },
+            }
+        snap[host_id] = {"topics": topics, "subscriptions": subs}
+    return snap
 
 
 # ---------------------------------------------------------------------------
@@ -1518,6 +1563,7 @@ class LiveNetwork:
         validate_ids: bool = False,
         chaos=None,
         retry_opts: Optional[RetryOpts] = None,
+        trace_sample: Optional[int] = None,
     ):
         self.peerstore = Peerstore(validate_ids=validate_ids)
         self.repair_timeout_s = repair_timeout_s
@@ -1526,9 +1572,14 @@ class LiveNetwork:
         # leaves every stream un-wrapped (the zero-overhead clean path).
         self.chaos = chaos
         self.retry_opts = retry_opts
+        # r19 cross-host tracing: trace 1-in-N messages per the ledger's
+        # deterministic hash-mod rule.  None = tracing off — no ledger is
+        # created anywhere and the plane stays bit- and counter-identical
+        # to the untraced regime.
+        self.trace_sample = trace_sample
         self.registry = MetricsRegistry()
         self._sync_hosts: List["SyncHost"] = []
-        self._metrics_server: Optional[MetricsHTTPServer] = None
+        self._metrics_server = None  # lazily-started obs.ObsHTTPServer
         self._loop = asyncio.new_event_loop()
         # LIVE_DEBUG=1: asyncio debug mode on the plane's loop — unawaited
         # coroutine warnings, slow-callback reports (anything over 100 ms
@@ -1564,16 +1615,25 @@ class LiveNetwork:
 
         One endpoint per network: all hosts share the network registry, and
         the topology snapshot covers every host created via :meth:`host`.
+        r19: delegates to :class:`~..obs.ObsHTTPServer` — one HTTP serving
+        path and one exposition formatter for both planes — with the live
+        topology snapshot mounted as an ``extra_json`` endpoint.
         """
         if self._metrics_server is None:
-            srv = MetricsHTTPServer(
+            from ..obs.server import ObsHTTPServer
+
+            srv = ObsHTTPServer(
                 self.registry,
-                sources=lambda: {h.id: h.tm for h in self._sync_hosts},
-                bind=bind,
+                host=bind,
+                extra_json={
+                    "/debug/tree": lambda: tree_snapshot(
+                        {h.id: h.tm for h in self._sync_hosts}
+                    ),
+                },
             )
-            self.call(srv.start())
+            srv.start()
             self._metrics_server = srv
-        return self._metrics_server._bind, self._metrics_server.port
+        return self._metrics_server._bind[0], self._metrics_server.port
 
     def host(self) -> "SyncHost":
         if self.peerstore.validate_ids:
@@ -1597,7 +1657,7 @@ class LiveNetwork:
     def shutdown(self) -> None:
         if self._metrics_server is not None:
             try:
-                self.call(self._metrics_server.aclose())
+                self._metrics_server.stop()
             except Exception:
                 pass
             self._metrics_server = None
@@ -1612,9 +1672,17 @@ class SyncHost:
         self.net = net
         self.live = host
         self.id = host.id
+        # Every host builds its OWN ledger: the hash-mod sampling rule is
+        # deterministic in the message key, so all hosts agree on which
+        # messages to trace with zero coordination; the merge step folds
+        # the per-host ledgers back into end-to-end traces.
+        self.ledger = (
+            SpanLedger(sample_n=net.trace_sample)
+            if net.trace_sample is not None else None
+        )
         self.tm = LiveTopicManager(
             host, repair_timeout_s=net.repair_timeout_s, registry=net.registry,
-            retry_opts=net.retry_opts,
+            retry_opts=net.retry_opts, ledger=self.ledger,
         )
         net._sync_hosts.append(self)
 

@@ -15,6 +15,10 @@ Three pieces, one plane:
   watchdog, and pipeline, rendered through ``render_prometheus``.
 - :mod:`.export` — trace-artifact builders for all three scenario planes
   (``--trace-out``), summarized by ``tools/trace_view.py``.
+- :mod:`.merge` — r19 cross-host collector: per-host live ledgers
+  (``obs-span-host/1``) fold into one ``obs-span-merged/1`` artifact of
+  end-to-end publish→delivery traces with propagation quantiles, per-hop
+  breakdown, and failover windows as annotated gaps.
 
 Everything here is host-side and strictly additive: with no tracer
 installed the serving plane runs bit- and counter-identical to r17.
@@ -22,17 +26,34 @@ installed the serving plane runs bit- and counter-identical to r17.
 
 from .blackbox import BlackBox
 from .export import build_record_artifact, build_span_artifact, write_json
+from .merge import (
+    build_host_span_artifact,
+    merge_host_artifacts,
+    propagation_latencies,
+)
 from .server import ObsHTTPServer
-from .spans import STAGES, SpanLedger, content_hash, envelope_span_key
+from .spans import (
+    HOP_STAGES,
+    STAGES,
+    SpanLedger,
+    content_hash,
+    envelope_span_key,
+    live_span_key,
+)
 
 __all__ = [
     "BlackBox",
+    "HOP_STAGES",
     "ObsHTTPServer",
     "STAGES",
     "SpanLedger",
+    "build_host_span_artifact",
     "build_record_artifact",
     "build_span_artifact",
     "content_hash",
     "envelope_span_key",
+    "live_span_key",
+    "merge_host_artifacts",
+    "propagation_latencies",
     "write_json",
 ]

@@ -1,18 +1,21 @@
-"""Serving-plane ``/metrics`` endpoint: the surface the live plane already
-has (``net/live.py``'s asyncio ``MetricsHTTPServer``) for the thread-world
-streaming plane.
+"""The repo's ONE telemetry HTTP server (r19: both planes serve through it).
 
 One :class:`~..utils.metrics.MetricsRegistry` — shared by the engine, the
-ingest ring, the watchdog, and the validation pipeline — rendered through
-``render_prometheus``:
+ingest ring, the watchdog, the validation pipeline, or a whole live
+network — rendered through ``render_prometheus``:
 
 - ``GET /metrics``    Prometheus text exposition (format 0.0.4);
 - ``GET /debug/obs``  JSON observability digest: span-ledger summary and
-  the black box's recent frames (when wired).
+  the black box's recent frames (when wired);
+- plus any ``extra_json`` endpoints the caller plugs in — the live plane
+  mounts its ``/debug/tree`` topology snapshot here, so both planes share
+  one serving path and one exposition formatter (the hand-rolled asyncio
+  ``MetricsHTTPServer`` that lived in ``net/live.py`` since r6 is gone).
 
-Runs a stdlib ``ThreadingHTTPServer`` on a daemon thread — the streaming
-plane is synchronous host code, so unlike the live plane there is no event
-loop to park a coroutine on.  Bind port 0 for an ephemeral port (tests).
+Runs a stdlib ``ThreadingHTTPServer`` on a daemon thread — works for
+synchronous host code and for the live plane alike (its snapshot callables
+only read loop-owned state, never await).  Bind port 0 for an ephemeral
+port (tests).
 """
 
 from __future__ import annotations
@@ -20,7 +23,7 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Callable, Dict, Optional
 
 
 class ObsHTTPServer:
@@ -33,10 +36,14 @@ class ObsHTTPServer:
         blackbox=None,
         host: str = "127.0.0.1",
         port: int = 0,
+        extra_json: Optional[Dict[str, Callable[[], object]]] = None,
     ) -> None:
         self.registry = registry
         self.ledger = ledger
         self.blackbox = blackbox
+        # path -> zero-arg callable returning a JSON-serializable doc,
+        # rendered sorted-keys like /debug/obs.  Reserved paths lose.
+        self.extra_json = dict(extra_json or {})
         self._bind = (host, port)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -57,6 +64,12 @@ class ObsHTTPServer:
                 elif path == "/debug/obs":
                     body = json.dumps(
                         owner._debug_doc(), sort_keys=True
+                    ).encode()
+                    ctype = "application/json"
+                    status = 200
+                elif path in owner.extra_json:
+                    body = json.dumps(
+                        owner.extra_json[path](), sort_keys=True
                     ).encode()
                     ctype = "application/json"
                     status = 200

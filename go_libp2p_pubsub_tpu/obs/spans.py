@@ -42,6 +42,13 @@ STAGES = (
     "device_delivery",
 )
 
+# Live-plane hop stages (r19, net/live.py): one message's path across
+# hosts.  "publish" lands on the origin; "send"/"replay_send" on every
+# fanning-out interior node; "recv"/"deliver" on every subscriber.  The
+# ledger accepts any stage string — this tuple is the vocabulary the
+# cross-host merge (obs/merge.py) orders hops by.
+HOP_STAGES = ("publish", "send", "recv", "deliver", "replay_send")
+
 
 def content_hash(topic: int, publisher: int, payload: bytes) -> str:
     """Stable identity of a publish for exactly-once dedup (hex).  Keyed on
@@ -50,6 +57,22 @@ def content_hash(topic: int, publisher: int, payload: bytes) -> str:
     h = hashlib.sha256()
     h.update(int(topic).to_bytes(4, "little"))
     h.update(int(publisher).to_bytes(8, "little"))
+    h.update(payload)
+    return h.hexdigest()[:32]
+
+
+def live_span_key(topic: str, payload: bytes) -> str:
+    """Span identity of a live-plane Data frame (hex, 32 chars — the same
+    shape as :func:`content_hash` so the deterministic hash-mod sampling
+    applies unchanged).  Keyed on (topic, wire payload): every host on the
+    frame's path computes the same key from the frame alone, so per-host
+    ledgers agree on identity AND sampling with no coordination.  The wire
+    payload (post-envelope on the signed plane) is hashed, not the
+    application bytes — receivers never need to unwrap to key a frame."""
+    h = hashlib.sha256()
+    topic_b = topic.encode()
+    h.update(len(topic_b).to_bytes(4, "little"))
+    h.update(topic_b)
     h.update(payload)
     return h.hexdigest()[:32]
 

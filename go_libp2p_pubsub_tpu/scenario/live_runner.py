@@ -76,6 +76,13 @@ class LiveScenarioResult:
     # Failover time-to-heal: wall seconds from the root kill to the first
     # survivor observed promoted (None when the scenario kills no root).
     heal_s: Optional[float] = None
+    # r19 cross-host tracing (trace_sample set): per-host span artifacts
+    # (``obs-span-host/1``, one per host that ran a ledger), their merge
+    # (``obs-span-merged/1``), and the merged propagation digest.  All None
+    # when tracing was off.
+    host_artifacts: Optional[List[dict]] = None
+    merged_trace: Optional[dict] = None
+    propagation: Optional[dict] = None
 
 
 def live_supported(spec: ScenarioSpec) -> bool:
@@ -162,12 +169,21 @@ def run_live_scenario(
     step_s: Optional[float] = None,
     settle_s: Optional[float] = None,
     trace_out: Optional[str] = None,
+    trace_sample: Optional[int] = None,
 ) -> LiveScenarioResult:
     """Lower ``spec`` onto a live tree under chaos and grade its SLOs.
 
     ``trace_out`` writes an ``obs-record-trace/1`` artifact from the
     synthesized flight record; the live plane steps on a real cadence, so
     the trace's time axis is seconds (``step_s`` per step).
+
+    ``trace_sample`` (r19) turns on cross-host distributed tracing: every
+    host runs its own :class:`~..obs.spans.SpanLedger` tracing the same
+    deterministic 1-in-N message subset, the latency SLO is graded from
+    span-exact propagation times instead of collector-thread receipt
+    times, and — when ``trace_out`` is also given — the per-host ledgers
+    plus their ``obs-span-merged/1`` merge land in a ``<trace_out
+    stem>.spans/`` directory next to the record trace.
     """
     _reject_unsupported(spec)
     live_cfg = spec.live or {}
@@ -186,7 +202,8 @@ def run_live_scenario(
     # Repair must complete well inside one latency "round" budget but not
     # so eagerly that one slow adoption dial gives up: a handful of steps.
     repair_s = max(6 * dt, 0.3)
-    net = LiveNetwork(repair_timeout_s=repair_s, chaos=chaos)
+    net = LiveNetwork(repair_timeout_s=repair_s, chaos=chaos,
+                      trace_sample=trace_sample)
 
     # -- plane bring-up (failures here are exit-2 material, not verdicts) --
     members: Dict[int, List[_Member]] = {}
@@ -214,6 +231,19 @@ def run_live_scenario(
                 verdict=res.verdict.to_dict(), record=res.record,
                 time_per_step_s=dt,
             ))
+            if res.merged_trace is not None:
+                import os
+
+                spans_dir = os.path.splitext(trace_out)[0] + ".spans"
+                os.makedirs(spans_dir, exist_ok=True)
+                for art in res.host_artifacts:
+                    write_json(
+                        os.path.join(spans_dir, f"host-{art['host']}.json"),
+                        art,
+                    )
+                write_json(
+                    os.path.join(spans_dir, "merged.json"), res.merged_trace
+                )
         return res
     finally:
         for gens in members.values():
@@ -476,12 +506,58 @@ def _drive(spec, net, chaos, hosts, topic, members, n, T, dt,
     if T:
         peers_orphaned[T - 1] = _count_orphans(members, current, n)
 
+    # -- cross-host span collection + merge (tracing on) -------------------
+    host_artifacts = merged = propagation = None
+    if net.trace_sample is not None:
+        from ..obs.merge import build_host_span_artifact, merge_host_artifacts
+        from ..obs.spans import live_span_key
+
+        # Every SyncHost ever created — killed originals and rejoined
+        # generations included: a dead host's ledger still holds the stamps
+        # it recorded while alive, which is exactly what a real collector
+        # would have scraped before the crash.
+        host_artifacts = [
+            build_host_span_artifact(sh.id, sh.ledger)
+            for sh in net._sync_hosts if sh.ledger is not None
+        ]
+        merged = merge_host_artifacts(host_artifacts, scenario=spec.name)
+        propagation = merged["propagation"]
+
     # -- synthesize the flight-record channels and grade -------------------
     n_pub = len(requests)
     record = _synthesize_record(
         spec, members, requests, pub_wall, t0, dt, T,
         peers_alive, peers_orphaned,
     )
+    if merged is not None and spec.family == "gossipsub" and T:
+        # Span-exact latency: re-grade the lat_hist channel from merged
+        # end-to-end propagation times (origin publish stamp → subscriber
+        # deliver stamp) instead of collector-thread receipt times.  The
+        # traced subset is the deterministic 1-in-N sample; quantile SLOs
+        # grade the sample.  Protoid survives promotion, so post-failover
+        # publishes key identically.
+        protoid = f"{hosts[0].id}/{TOPIC}"
+        traced_keys = {
+            live_span_key(protoid, pub_payloads[i]) for i in range(n_pub)
+        }
+        B = record["lat_hist"].shape[1]
+        span_hist = np.zeros((T, B), np.int64)
+        span_lats: List[float] = []
+        for tr in merged["traces"]:
+            if tr["key"] not in traced_keys or tr["publish"] is None:
+                continue
+            for d in tr["deliveries"]:
+                recv_step = min(T - 1, max(0, int((d["t"] - t0) / dt)))
+                lat = max(0, int(d["latency_s"] / dt))
+                span_hist[recv_step, min(lat, B - 1)] += 1
+                span_lats.append(d["latency_s"])
+        if span_lats:
+            record["lat_hist"] = np.cumsum(span_hist, axis=0)
+            from ..utils.metrics import quantiles
+
+            q = quantiles(span_lats, (0.5, 0.99))
+            record["span_prop_p50_s"] = np.full(T, q["p50"], np.float64)
+            record["span_prop_p99_s"] = np.full(T, q["p99"], np.float64)
     # Failover channels (family-agnostic; constant series read at [-1] by
     # slo.evaluate): the surviving members' epoch agreement and the total
     # duplicate deliveries across every generation.
@@ -497,6 +573,8 @@ def _drive(spec, net, chaos, hosts, topic, members, n, T, dt,
         max(T, 1),
         sum(m.dups for gens in members.values() for m in gens), np.int64)
     verdict = slo_mod.evaluate(spec, record, n_pub)
+    if merged is not None:
+        merged["verdict"] = verdict.to_dict()
     return LiveScenarioResult(
         spec=spec,
         verdict=verdict,
@@ -506,6 +584,9 @@ def _drive(spec, net, chaos, hosts, topic, members, n, T, dt,
         counters=net.registry.counters(),
         seconds=round(time.monotonic() - t_begin, 3),
         heal_s=round(heal_s, 3) if heal_s is not None else None,
+        host_artifacts=host_artifacts,
+        merged_trace=merged,
+        propagation=propagation,
     )
 
 

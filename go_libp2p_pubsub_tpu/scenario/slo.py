@@ -6,6 +6,14 @@ just *that* the campaign regressed but *which* guarantee broke and by how
 much.  Every measurement is sourced from the flight record the rollout
 scan emitted (PR 1's recorder plus the campaign channels) — the verdict
 is a pure host-side reduction of device telemetry, never a re-simulation.
+
+r19: on the live plane with cross-host tracing enabled, the runner
+substitutes the ``lat_hist`` channel with one rebuilt from span-exact
+propagation times (origin publish stamp → subscriber deliver stamp, merged
+across per-host ledgers by ``obs.merge``) before grading, and adds
+``span_prop_p50_s``/``span_prop_p99_s`` channels carrying the merged
+second-domain quantiles.  The latency criteria below read the substituted
+histogram unchanged — span-exact verdicts need no new criterion kinds.
 """
 
 from __future__ import annotations

@@ -85,6 +85,25 @@ class Message:
     epoch: int = 0
     successors: List[str] = field(default_factory=list)
     roster: List[str] = field(default_factory=list)
+    # Distributed-tracing extensions (r19, obs/merge.py): the origin marks a
+    # Data frame ``traced`` when its span ledger sampled the message, so
+    # every downstream host stamps hop spans without re-negotiating the
+    # sampling decision on the wire (the decision itself is recomputable
+    # from the payload — the marker just spares untraced frames the hash).
+    # ``clock_offset`` is the ORIGIN's host-clock offset estimate (seconds,
+    # relative to the deployment's reference clock): receivers record it on
+    # the recv stamp so the cross-host merge can normalize timestamps even
+    # for hosts whose own estimate never reached the collector.  Both are
+    # serialized only when set — untraced traffic stays byte-identical to
+    # the reference encoder.
+    traced: bool = False
+    clock_offset: float = 0.0
+    # In-memory span-key memo — NEVER serialized and excluded from
+    # equality: hosts stamp a traced frame at several sites (recv, deliver,
+    # forward) and the sha256 identity is the same at each, so the first
+    # stamp caches it here for the rest of the frame's life on this host.
+    span_key: Optional[str] = field(
+        default=None, init=False, compare=False, repr=False)
 
     def to_json_obj(self) -> dict:
         # Field order matches the Go struct declaration order so encoded bytes
@@ -108,6 +127,10 @@ class Message:
             obj["successors"] = list(self.successors)
         if self.roster:
             obj["roster"] = list(self.roster)
+        if self.traced:
+            obj["traced"] = True
+        if self.clock_offset:
+            obj["clockoff"] = self.clock_offset
         return obj
 
     @classmethod
@@ -124,6 +147,8 @@ class Message:
             epoch=int(obj.get("epoch", 0)),
             successors=list(obj.get("successors", []) or []),
             roster=list(obj.get("roster", []) or []),
+            traced=bool(obj.get("traced", False)),
+            clock_offset=float(obj.get("clockoff", 0.0)),
         )
 
 

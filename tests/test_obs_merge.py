@@ -1,0 +1,469 @@
+"""Cross-host distributed tracing: the r19 merge plane (ISSUE 16).
+
+Contracts under test, in order of importance:
+
+1. Sampling agreement needs no coordination: independent per-host ledgers
+   compute the same traced subset from ``live_span_key`` alone, and the
+   key depends only on (topic, payload) — never on the observing host.
+2. The merge is deterministic in the input *set*: shuffling the host
+   artifact list (and the spans inside each) yields a byte-identical
+   ``obs-span-merged/1`` artifact.
+3. Clock-offset normalization: per-host ``clock_offset_s`` estimates are
+   subtracted before any cross-host comparison, so skewed hosts still
+   produce the true reference-clock propagation latencies.
+4. Failover windows merge into one annotated ``recovery_gap`` spanning
+   exactly the hosts that observed them (promotion and park/merge kinds).
+5. ``tools/trace_view.py --merge DIR`` re-merges the per-host files
+   byte-identically to the runner's own merged.json; ``tools/perf_diff.py``
+   warns (never crashes) on records that predate the r19 ``live_obs``
+   section.
+6. (slow) A traced live canon run emits per-host artifacts whose merge
+   covers every delivery, and a traced failover run's recovery gap agrees
+   with the runner's independently measured ``heal_s`` within one step.
+"""
+
+import json
+import os
+import random
+import subprocess
+import sys
+
+import pytest
+
+from go_libp2p_pubsub_tpu.obs import (
+    HOP_STAGES,
+    SpanLedger,
+    build_host_span_artifact,
+    live_span_key,
+    merge_host_artifacts,
+    propagation_latencies,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# sampling agreement (tentpole: no-coordination tracing decisions)
+# ---------------------------------------------------------------------------
+
+
+def test_live_span_key_is_host_independent():
+    """The key hashes (topic, payload) only — every host on a frame's
+    path computes the identical identity from the frame alone."""
+    k1 = live_span_key("root7/updates", b"payload bytes")
+    k2 = live_span_key("root7/updates", b"payload bytes")
+    assert k1 == k2
+    assert len(k1) == 32 and int(k1, 16) >= 0  # content_hash shape
+    # Both inputs are load-bearing.
+    assert live_span_key("root8/updates", b"payload bytes") != k1
+    assert live_span_key("root7/updates", b"payload bytez") != k1
+    # Length prefix keeps (topic, payload) framing unambiguous.
+    assert live_span_key("ab", b"c") != live_span_key("a", b"bc")
+
+
+def test_cross_host_sampling_agreement():
+    """16 independent ledgers at the same rate partition the message
+    space identically — the distributed sampling contract."""
+    ledgers = [SpanLedger(sample_n=8) for _ in range(16)]
+    keys = [live_span_key("r/t", b"msg:%d" % i) for i in range(256)]
+    verdicts = [[led.sampled(k) for k in keys] for led in ledgers]
+    assert all(v == verdicts[0] for v in verdicts[1:])
+    n_traced = sum(verdicts[0])
+    assert 0 < n_traced < len(keys)  # a real subset, not all-or-nothing
+
+
+# ---------------------------------------------------------------------------
+# synthetic multi-host fixtures
+# ---------------------------------------------------------------------------
+
+
+def _mk_host(host, stamps, events=(), clock_offset_s=0.0, sample_n=1,
+             open_annotations=()):
+    """One host artifact from explicit (key, stage, t, attrs) stamps."""
+    led = SpanLedger(sample_n=sample_n)
+    for key, stage, t, attrs in stamps:
+        assert led.stamp(key, stage, t=t, **attrs)
+    for name, t, attrs in open_annotations:
+        led.annotate_open(name, t=t, **attrs)
+    for name, t, attrs in events:
+        led.event(name, t=t, **attrs)
+    return build_host_span_artifact(
+        host, led, clock_offset_s=clock_offset_s
+    )
+
+
+_KEY_A = live_span_key("r/t", b"alpha")
+_KEY_B = live_span_key("r/t", b"beta")
+
+
+def _three_host_artifacts():
+    """Origin h0 publishes two messages; h1 relays; h1+h2 deliver."""
+    h0 = _mk_host("h0", [
+        (_KEY_A, "publish", 1.000, {"bytes": 5}),
+        (_KEY_A, "send", 1.001, {"fanout": 1}),
+        (_KEY_B, "publish", 2.000, {"bytes": 4}),
+        (_KEY_B, "send", 2.001, {"fanout": 1}),
+    ])
+    h1 = _mk_host("h1", [
+        (_KEY_A, "recv", 1.011, {"from": "h0"}),
+        (_KEY_A, "deliver", 1.012, {}),
+        (_KEY_A, "send", 1.013, {"fanout": 1}),
+        (_KEY_B, "recv", 2.021, {"from": "h0"}),
+        (_KEY_B, "deliver", 2.022, {}),
+        (_KEY_B, "send", 2.023, {"fanout": 1}),
+    ])
+    h2 = _mk_host("h2", [
+        (_KEY_A, "recv", 1.030, {"from": "h1"}),
+        (_KEY_A, "deliver", 1.032, {}),
+        (_KEY_B, "recv", 2.040, {"from": "h1"}),
+        (_KEY_B, "deliver", 2.041, {}),
+    ])
+    return [h0, h1, h2]
+
+
+def test_host_artifact_shape():
+    art = _three_host_artifacts()[0]
+    assert art["format"] == "obs-span-host/1"
+    assert art["host"] == "h0"
+    assert art["sample_n"] == 1
+    assert len(art["spans"]) == 2
+    assert all(s["stamps"] for s in art["spans"])
+    assert art["dropped_spans"] == 0
+
+
+def test_merge_end_to_end_traces_and_per_hop():
+    merged = merge_host_artifacts(_three_host_artifacts())
+    assert merged["format"] == "obs-span-merged/1"
+    assert merged["hosts"] == ["h0", "h1", "h2"]
+    prop = merged["propagation"]
+    assert prop["messages"] == 2
+    assert prop["deliveries"] == 4  # h1+h2 for each message
+    # Message A: h1 at 12 ms, h2 at 32 ms after the publish stamp.
+    tr = {t["key"]: t for t in merged["traces"]}
+    lat_a = {d["host"]: d["latency_s"] for d in tr[_KEY_A]["deliveries"]}
+    assert lat_a["h1"] == pytest.approx(0.012)
+    assert lat_a["h2"] == pytest.approx(0.032)
+    assert tr[_KEY_A]["publish"]["host"] == "h0"
+    assert tr[_KEY_A]["hosts"] == ["h0", "h1", "h2"]
+    # Per-hop breakdown pairs each recv to ITS sender's send stamp.
+    hops = prop["per_hop"]
+    assert hops["send->recv"]["count"] == 4
+    # Edge latencies are 10/17/17/20 ms in the fixture.
+    assert 0.01 <= hops["send->recv"]["p50"] <= 0.02
+    assert hops["publish->send"]["count"] == 2
+    assert hops["recv->deliver"]["count"] == 4
+    assert hops["recv->send"]["count"] == 2  # only the relay h1
+    # Flattened rows feed the live runner's span-exact lat_hist.
+    rows = propagation_latencies(merged)
+    assert len(rows) == 4
+    assert all(lat > 0 for _, _, lat in rows)
+    # Every hop stage the write side can emit is in the stage vocabulary.
+    seen = {r["stage"] for t in merged["traces"] for r in t["hops"]}
+    assert seen <= set(HOP_STAGES)
+
+
+def test_merge_shuffled_input_is_byte_identical():
+    arts = _three_host_artifacts()
+    ref = json.dumps(merge_host_artifacts(arts), sort_keys=True)
+    rng = random.Random(19)
+    for _ in range(4):
+        shuffled = list(arts)
+        rng.shuffle(shuffled)
+        for art in shuffled:
+            rng.shuffle(art["spans"])
+            for span in art["spans"]:
+                rng.shuffle(span["stamps"])
+        got = json.dumps(merge_host_artifacts(shuffled), sort_keys=True)
+        assert got == ref
+
+
+def test_merge_normalizes_clock_offsets():
+    """h2's clock runs 5 s ahead; its offset estimate folds the stamps
+    back onto the reference clock, so latencies match the unskewed run."""
+    skewed = _three_host_artifacts()
+    base = merge_host_artifacts(_three_host_artifacts())
+    h2 = skewed[2]
+    for span in h2["spans"]:
+        for rec in span["stamps"]:
+            rec["t"] += 5.0
+    h2["clock_offset_s"] = 5.0
+    merged = merge_host_artifacts(skewed)
+    # Equal up to float subtraction noise ((t + 5.0) - 5.0 != t exactly).
+    for field in ("p50_s", "p99_s", "max_s"):
+        assert merged["propagation"][field] == \
+            pytest.approx(base["propagation"][field], abs=1e-9)
+    assert merged["propagation"]["deliveries"] == \
+        base["propagation"]["deliveries"]
+    skewed_lat = sorted(r[2] for r in propagation_latencies(merged))
+    base_lat = sorted(r[2] for r in propagation_latencies(base))
+    assert skewed_lat == pytest.approx(base_lat, abs=1e-9)
+
+
+def test_merge_input_validation():
+    arts = _three_host_artifacts()
+    with pytest.raises(ValueError, match="at least one"):
+        merge_host_artifacts([])
+    with pytest.raises(ValueError, match="not an obs-span-host/1"):
+        merge_host_artifacts([{"format": "obs-blackbox/1"}])
+    with pytest.raises(ValueError, match="duplicate host"):
+        merge_host_artifacts([arts[0], arts[0]])
+    mixed = _three_host_artifacts()
+    mixed[1]["sample_n"] = 4
+    with pytest.raises(ValueError, match="sample_n"):
+        merge_host_artifacts(mixed)
+
+
+# ---------------------------------------------------------------------------
+# failover windows -> annotated gaps
+# ---------------------------------------------------------------------------
+
+
+def test_recovery_gap_promotion_kind():
+    """Root kill: first parent_lost -> first promoted, across exactly the
+    hosts that observed either side of the window."""
+    arts = _three_host_artifacts()
+    arts[1]["events"] = [{"name": "parent_lost", "t": 3.0, "peer": "h0"}]
+    arts[2]["events"] = [
+        {"name": "parent_lost", "t": 3.2, "peer": "h0"},
+        {"name": "promoted", "t": 3.5, "epoch": 1},
+    ]
+    merged = merge_host_artifacts(arts)
+    gap = merged["recovery_gap"]
+    assert gap["kind"] == "promotion"
+    assert gap["gap_s"] == pytest.approx(0.5)
+    assert gap["hosts"] == ["h1", "h2"]
+    # The window renders as an annotated X event on the cluster track.
+    anns = [e for e in merged["chrome_trace"]["traceEvents"]
+            if e.get("cat") == "annotation"]
+    assert len(anns) == 1 and anns[0]["name"] == "failover_gap"
+    assert anns[0]["tid"] == 0
+    assert anns[0]["args"]["kind"] == "promotion"
+
+
+def test_recovery_gap_park_merge_kind_and_open_span_annotation():
+    """Partition minority: first failover_parked -> last heal event; the
+    park/merge instants also land on every then-open span."""
+    arts = _three_host_artifacts()
+    arts[2]["events"] = [
+        {"name": "failover_parked", "t": 4.0, "epoch": 0, "rank": -1},
+        {"name": "failover_merged", "t": 6.5, "how": "healed"},
+    ]
+    merged = merge_host_artifacts(arts)
+    gap = merged["recovery_gap"]
+    assert gap["kind"] == "park_merge"
+    assert gap["gap_s"] == pytest.approx(2.5)
+    assert gap["hosts"] == ["h2"]
+    # No heal anywhere -> nothing to annotate.
+    quiet = merge_host_artifacts(_three_host_artifacts())
+    assert quiet["recovery_gap"] is None
+    # annotate_open attaches the park instant to open spans, and the merge
+    # carries span-scoped events with their span key.
+    arts2 = _three_host_artifacts()
+    parked = _mk_host("h3", [
+        (_KEY_A, "recv", 3.9, {"from": "h1"}),
+    ], open_annotations=[("failover_park", 4.0, {"epoch": 0})])
+    merged2 = merge_host_artifacts(arts2 + [parked])
+    span_evs = [e for e in merged2["events"]
+                if e.get("span") == _KEY_A and e["name"] == "failover_park"]
+    assert len(span_evs) == 1 and span_evs[0]["host"] == "h3"
+
+
+# ---------------------------------------------------------------------------
+# chrome / otlp rendering
+# ---------------------------------------------------------------------------
+
+
+def test_merged_chrome_trace_one_track_per_host():
+    merged = merge_host_artifacts(_three_host_artifacts())
+    evs = merged["chrome_trace"]["traceEvents"]
+    names = {e["args"]["name"] for e in evs if e["ph"] == "M"}
+    assert names == {"cluster", "host h0", "host h1", "host h2"}
+    segs = [e for e in evs if e["ph"] == "X" and e.get("cat") == "message"]
+    # Each of 2 messages renders one segment per host it touched (3 hosts).
+    assert len(segs) == 6
+    assert all(e["dur"] >= 0 for e in segs)
+
+
+def test_merged_otlp_shares_trace_id_across_hosts():
+    merged = merge_host_artifacts(_three_host_artifacts())
+    otlp = merged["otlp"]
+    assert len(otlp["resourceSpans"]) == 3
+    ids = {}
+    for rs in otlp["resourceSpans"]:
+        for span in rs["scopeSpans"][0]["spans"]:
+            ids.setdefault(span["traceId"], set()).add(span["spanId"])
+    # 2 messages -> 2 traceIds, each reassembling 3 per-host spans.
+    assert len(ids) == 2
+    assert all(len(spans) == 3 for spans in ids.values())
+
+
+# ---------------------------------------------------------------------------
+# tools: trace_view --merge, perf_diff pre-r19 (satellites 3 and 5)
+# ---------------------------------------------------------------------------
+
+
+def _write_span_dir(tmp_path):
+    d = tmp_path / "run.spans"
+    d.mkdir()
+    arts = _three_host_artifacts()
+    for art in arts:
+        (d / f"host-{art['host']}.json").write_text(json.dumps(art))
+    merged = merge_host_artifacts(arts)
+    (d / "merged.json").write_text(
+        json.dumps(merged, indent=1, sort_keys=True)
+    )
+    return d, merged
+
+
+def _trace_view(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_view.py"),
+         *args],
+        capture_output=True, text=True, timeout=120,
+    )
+
+
+def test_trace_view_merge_dir_matches_runner_merge(tmp_path):
+    """--merge re-merges the per-host files independently of the runner's
+    merged.json; the summaries must agree field for field."""
+    d, merged = _write_span_dir(tmp_path)
+    r = _trace_view("--merge", str(d), "--json")
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout)
+    assert out["format"] == "obs-span-merged/1"
+    prop = merged["propagation"]
+    assert out["hosts"] == merged["hosts"]
+    assert out["messages"] == prop["messages"]
+    assert out["deliveries"] == prop["deliveries"]
+    assert out["p50_s"] == prop["p50_s"]
+    assert out["p99_s"] == prop["p99_s"]
+    assert out["per_hop"] == prop["per_hop"]
+    assert out["chrome_events"] == \
+        len(merged["chrome_trace"]["traceEvents"])
+
+
+def test_trace_view_merge_summary_and_host_artifact(tmp_path):
+    d, _ = _write_span_dir(tmp_path)
+    r = _trace_view("--merge", str(d))
+    assert r.returncode == 0, r.stderr
+    assert "merged trace" in r.stdout
+    assert "propagation:" in r.stdout
+    rh = _trace_view(str(d / "host-h1.json"))
+    assert rh.returncode == 0, rh.stderr
+    assert "host" in rh.stdout
+
+
+def test_trace_view_merge_arg_validation(tmp_path):
+    d, _ = _write_span_dir(tmp_path)
+    both = _trace_view(str(d / "merged.json"), "--merge", str(d))
+    assert both.returncode != 0
+    neither = _trace_view()
+    assert neither.returncode != 0
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    r = _trace_view("--merge", str(empty))
+    assert r.returncode != 0
+
+
+def test_perf_diff_warns_on_pre_r19_record(tmp_path):
+    """An r18 record has no 'live_obs' section — diffing it against an r19
+    record must warn one-sidedly and exit 0, and the r19 rows render."""
+    old = {"metric": "m", "value": 100.0, "methodology_version": 2,
+           "backend": "cpu", "n_peers": 16}
+    new = dict(old, live_obs={
+        "n_hosts": 16, "trace_sample": 16,
+        "untraced_msgs_per_sec": 9000.0, "traced_msgs_per_sec": 8950.0,
+        "overhead_frac": 0.0056, "overhead_budget_frac": 0.02,
+        "merged_prop_p50_s": 0.0026, "merged_prop_p99_s": 0.0048,
+    })
+    po, pn = tmp_path / "o.json", tmp_path / "n.json"
+    po.write_text(json.dumps(old))
+    pn.write_text(json.dumps(new))
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "perf_diff.py"),
+         str(po), str(pn)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "WARNING" in r.stdout
+    assert "live_obs" in r.stdout and "r19" in r.stdout
+    assert "live obs overhead frac" in r.stdout
+    assert "live merged propagation p50" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# live plane end-to-end (slow)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestTracedLivePlane:
+    def test_traced_canon_small_tree_full_coverage(self, tmp_path):
+        """A traced degraded_links run emits one artifact per host whose
+        merge covers EVERY delivery, and the verdict rides the artifact."""
+        from go_libp2p_pubsub_tpu import scenario
+
+        spec = scenario.build("degraded_links")
+        out = tmp_path / "run.json"
+        res = scenario.run_live_scenario(
+            spec, n_hosts=4, step_s=0.04, trace_sample=1,
+            trace_out=str(out),
+        )
+        assert res.verdict.passed, res.verdict.to_dict()
+        assert res.host_artifacts is not None
+        assert len(res.host_artifacts) == 4
+        assert {a["format"] for a in res.host_artifacts} == \
+            {"obs-span-host/1"}
+        merged = res.merged_trace
+        assert merged["format"] == "obs-span-merged/1"
+        assert merged["scenario"] == "degraded_links"
+        assert merged["verdict"]["passed"] is True
+        prop = res.propagation
+        assert prop["messages"] == res.n_publishes
+        assert prop["deliveries"] == res.n_publishes * 3  # every subscriber
+        assert 0 < prop["p50_s"] <= prop["p99_s"]
+        # The runner persisted the per-host + merged artifacts on disk and
+        # they re-merge to the same document.
+        spans_dir = tmp_path / "run.spans"
+        hosts_on_disk = sorted(spans_dir.glob("host-*.json"))
+        assert len(hosts_on_disk) == 4
+        disk = json.loads((spans_dir / "merged.json").read_text())
+        assert disk["propagation"] == prop
+        # Span-exact quantiles ride the graded record as channels.
+        assert res.record["span_prop_p50_s"][-1] == \
+            pytest.approx(prop["p50_s"])
+
+    def test_traced_failover_gap_matches_runner_heal(self, tmp_path):
+        """The merged recovery gap (span plane) and the runner's heal_s
+        (driver plane) measure the same outage independently — they must
+        agree within one scenario step."""
+        from go_libp2p_pubsub_tpu import scenario
+
+        spec = scenario.build("root_kill_failover")
+        step_s = spec.live.get("step_ms", 50.0) / 1e3 \
+            if getattr(spec, "live", None) else 0.05
+        res = scenario.run_live_scenario(spec, trace_sample=1)
+        assert res.verdict.passed, res.verdict.to_dict()
+        assert res.heal_s is not None
+        gap = res.merged_trace["recovery_gap"]
+        assert gap is not None and gap["kind"] == "promotion"
+        assert gap["gap_s"] <= res.heal_s + step_s
+        assert len(gap["hosts"]) >= 1
+
+    def test_untraced_live_plane_has_no_ledgers(self):
+        """trace_sample=None (the default) builds NO ledger objects —
+        the r18-identical plane, not a sampled-to-zero one."""
+        from go_libp2p_pubsub_tpu.net.live import LiveNetwork
+
+        net = LiveNetwork()
+        try:
+            hosts = net.make_hosts(3)
+            assert all(h.ledger is None for h in hosts)
+            topic = hosts[0].new_topic("t")
+            subs = [h.subscribe(hosts[0].id, "t") for h in hosts[1:]]
+            topic.publish_message(b"untraced")
+            for s in subs:
+                assert s.get(timeout=5.0) == b"untraced"
+            assert all(h.ledger is None for h in hosts)
+        finally:
+            net.shutdown()

@@ -139,6 +139,52 @@ def test_decode_missing_failover_fields_defaults():
     assert m.epoch == 0 and m.successors == [] and m.roster == []
 
 
+def test_trace_fields_absent_stay_byte_identical_to_reference():
+    # r19 tracing extensions: an UNTRACED frame — traced False, zero clock
+    # offset — must not grow a single wire byte; the r9 goldens above keep
+    # holding and this vector pins the defaults explicitly.
+    m = Message(type=MessageType.DATA, data=b"hi", traced=False,
+                clock_offset=0.0)
+    assert encode_message(m) == b'{"Type":0,"data":"aGk="}\n'
+    assert encode_message(Message(type=MessageType.JOIN)) == b'{"Type":1}\n'
+
+
+def test_golden_traced_data_frame():
+    # Origin-sampled Data frame: the traced marker (and, when the origin
+    # has one, its clock-offset estimate) trail every earlier key so a
+    # reference decoder sees the known prefix unchanged.
+    m = Message(type=MessageType.DATA, data=b"hi", traced=True)
+    assert encode_message(m) == b'{"Type":0,"data":"aGk=","traced":true}\n'
+    m = Message(type=MessageType.DATA, data=b"hi", epoch=2, traced=True,
+                clock_offset=0.25)
+    assert encode_message(m) == (
+        b'{"Type":0,"data":"aGk=","epoch":2,"traced":true,"clockoff":0.25}\n'
+    )
+
+
+def test_decode_traced_and_clock_offset():
+    m = decode_message(
+        b'{"Type":0,"data":"aGk=","traced":true,"clockoff":-0.5}')
+    assert m.traced is True and m.clock_offset == -0.5
+    # Reference-era frame: absent keys decode to the untraced defaults.
+    m = decode_message(b'{"Type":0,"data":"aGk="}')
+    assert m.traced is False and m.clock_offset == 0.0
+
+
+@pytest.mark.parametrize(
+    "m",
+    [
+        Message(type=MessageType.DATA, data=b"x", traced=True),
+        Message(type=MessageType.DATA, data=b"x", traced=True,
+                clock_offset=1.5e-3),
+        Message(type=MessageType.DATA, data=b"x", replay=True, epoch=1,
+                traced=True, clock_offset=-2.0),
+    ],
+)
+def test_roundtrip_traced(m):
+    assert decode_message(encode_message(m)) == m
+
+
 def test_decode_go_style_input():
     # Go decoder tolerates fields in any order and unknown fields.
     raw = b'{"data":"aGVsbG8=","Type":0,"unknown":1}'

@@ -135,6 +135,21 @@ SCALAR_ROWS: List[Tuple[Tuple[str, ...], str, bool]] = [
      "streaming span-exact p99 (s)", False),
     (("streaming", "obs", "chunk_p50_s"),
      "streaming chunk-quantized p50 (s)", False),
+    # Live-plane cross-host tracing A/B (r19+); warn-not-crash when a
+    # record predates it.  ``overhead_frac`` is the acceptance headline
+    # (traced vs untraced delivered msgs/sec on the interleaved 16-host
+    # A/B, budget <= 2%); the merged rows are the span-exact end-to-end
+    # propagation quantiles out of the cross-host merge.
+    (("live_obs", "overhead_frac"),
+     "live obs overhead frac", False),
+    (("live_obs", "traced_msgs_per_sec"),
+     "live traced msgs/sec", True),
+    (("live_obs", "untraced_msgs_per_sec"),
+     "live untraced msgs/sec", True),
+    (("live_obs", "merged_prop_p50_s"),
+     "live merged propagation p50 (s)", False),
+    (("live_obs", "merged_prop_p99_s"),
+     "live merged propagation p99 (s)", False),
     # Adaptive coded gossip section (r16+); same warn-not-crash behavior
     # as sharded/rlnc/streaming when a record predates it.  The headline is
     # the crossover loss rate (lower = the adaptive plane starts winning
@@ -413,6 +428,30 @@ def context_warnings(old: Dict[str, Any], new: Dict[str, Any]) -> List[str]:
                 f"(missing in {which}; added in r18) — obs overhead/span "
                 f"rows are one-sided"
             )
+    # Live-plane tracing A/B section (r19+): a pre-r19 record never ran the
+    # cross-host ledger overhead measurement — warn, don't crash.
+    lo, ln = old.get("live_obs"), new.get("live_obs")
+    if (lo is None) != (ln is None):
+        which = "old" if lo is None else "new"
+        warns.append(
+            f"only one record has a 'live_obs' section (missing in {which}; "
+            f"added in r19) — live tracing overhead/propagation rows are "
+            f"one-sided"
+        )
+    for name, s in (("old", lo), ("new", ln)):
+        if isinstance(s, dict) and "error" in s:
+            warns.append(
+                f"{name} live_obs section is an error record: "
+                f"{str(s['error'])[:200]}"
+            )
+    if (isinstance(lo, dict) and isinstance(ln, dict)
+            and "error" not in lo and "error" not in ln):
+        for key in ("n_hosts", "trace_sample"):
+            if lo.get(key) != ln.get(key):
+                warns.append(
+                    f"live_obs {key} differs: {lo.get(key)!r} vs "
+                    f"{ln.get(key)!r}"
+                )
     # Adaptive coded gossip section (r16+): same treatment.
     ho, hn = old.get("hybrid"), new.get("hybrid")
     if (ho is None) != (hn is None):

@@ -82,8 +82,13 @@ def main(argv: List[str] | None = None) -> int:
                     "flight-record channel traces on sim/live; view with "
                     "tools/trace_view.py or chrome://tracing")
     ap.add_argument("--trace-sample", type=int, default=1, metavar="N",
-                    help="streaming plane: trace every Nth sampled message "
-                    "(deterministic on content hash; default 1 = all)")
+                    help="streaming/live planes: trace every Nth sampled "
+                    "message (deterministic on content hash; default 1 = "
+                    "all).  On the live plane, --trace-out turns on "
+                    "cross-host tracing at this rate: every host ledgers "
+                    "the same 1/N subset, the run grades span-exact "
+                    "propagation, and per-host + merged span artifacts "
+                    "land in <trace-out stem>.spans/")
     ap.add_argument("--json", action="store_true",
                     help="emit verdicts as JSON instead of the table")
     ap.add_argument("--plane", choices=("sim", "live", "streaming"),
@@ -199,6 +204,11 @@ def main(argv: List[str] | None = None) -> int:
                     step_s=(args.live_step_ms / 1e3
                             if args.live_step_ms is not None else None),
                     trace_out=args.trace_out,
+                    # Cross-host tracing rides the artifact request: no
+                    # --trace-out, no ledgers — the untraced plane stays
+                    # bit-identical to r18.
+                    trace_sample=(args.trace_sample
+                                  if args.trace_out else None),
                 )
             except scenario.LivePlaneError as e:
                 print(f"error: {e}", file=sys.stderr)

@@ -6,6 +6,7 @@ Usage::
     python tools/trace_view.py trace.json
     python tools/trace_view.py trace.json --json     # machine-readable
     python tools/trace_view.py crash.postmortem.json # black-box dump
+    python tools/trace_view.py --merge trace.spans/  # merge per-host ledgers
 
 Switches on the artifact's ``format`` key:
 
@@ -15,7 +16,15 @@ Switches on the artifact's ``format`` key:
 - ``obs-record-trace/1``   — sim/live flight-record trace: per-channel
   stats + verdict;
 - ``obs-blackbox/1``       — watchdog post-mortem: the last-K per-chunk
-  frames leading up to an engine restart.
+  frames leading up to an engine restart;
+- ``obs-span-merged/1``    — r19 cross-host merge: end-to-end per-message
+  traces, propagation quantiles, per-hop breakdown, failover gap;
+- ``obs-span-host/1``      — one live host's ledger (input to the merge).
+
+``--merge DIR`` re-merges the ``host-*.json`` per-host artifacts a traced
+live run dropped in its ``<trace>.spans/`` directory and summarizes the
+result — byte-identical to the ``merged.json`` the runner wrote (the merge
+is deterministic), useful when hosts were scraped separately.
 
 The artifact itself is self-contained — its ``chrome_trace`` member loads
 directly in ``chrome://tracing`` / Perfetto; this tool is the terminal
@@ -26,7 +35,9 @@ error, distinct from anything the run itself did).
 from __future__ import annotations
 
 import argparse
+import glob
 import json
+import os
 import sys
 from typing import Any, Dict, List
 
@@ -147,19 +158,115 @@ def _print_blackbox(doc: Dict[str, Any], out: Dict[str, Any]) -> None:
               f"completed={fr.get('completed')} shed={fr.get('shed_priority')}")
 
 
+def _merged_summary(doc: Dict[str, Any]) -> Dict[str, Any]:
+    prop = doc.get("propagation", {})
+    return {
+        "format": doc["format"],
+        "scenario": doc.get("scenario"),
+        "passed": (doc.get("verdict") or {}).get("passed"),
+        "hosts": doc.get("hosts", []),
+        "messages": prop.get("messages"),
+        "deliveries": prop.get("deliveries"),
+        "sample_n": prop.get("sample_n"),
+        "p50_s": prop.get("p50_s"),
+        "p99_s": prop.get("p99_s"),
+        "max_s": prop.get("max_s"),
+        "per_hop": prop.get("per_hop", {}),
+        "events": len(doc.get("events", [])),
+        "recovery_gap": doc.get("recovery_gap"),
+        "chrome_events": len(
+            doc.get("chrome_trace", {}).get("traceEvents", [])),
+    }
+
+
+def _print_merged(out: Dict[str, Any]) -> None:
+    passed = out["passed"]
+    verdict = "PASS" if passed else ("FAIL" if passed is not None else "-")
+    print(f"merged trace  {out['scenario'] or '(unnamed)'}  "
+          f"hosts={len(out['hosts'])}  {verdict}")
+    print(f"  propagation: {out['messages']} msgs, {out['deliveries']} "
+          f"deliveries (1/{out['sample_n']} sampled)  "
+          f"p50={_fmt_s(out['p50_s'])} p99={_fmt_s(out['p99_s'])} "
+          f"max={_fmt_s(out['max_s'])}")
+    for name in sorted(out["per_hop"]):
+        h = out["per_hop"][name]
+        print(f"  {name:18s} n={h['count']:<6d} p50={_fmt_s(h['p50'])} "
+              f"p99={_fmt_s(h['p99'])}")
+    gap = out.get("recovery_gap")
+    if gap:
+        print(f"  failover gap [{gap['kind']}]: {_fmt_s(gap['gap_s'])} "
+              f"across {len(gap['hosts'])} host(s)")
+    print(f"  ledger events: {out['events']}")
+    print(f"  chrome_trace: {out['chrome_events']} events "
+          f"(one track per host; load in chrome://tracing)")
+
+
+def _host_summary(doc: Dict[str, Any]) -> Dict[str, Any]:
+    s = doc.get("summary", {})
+    return {
+        "format": doc["format"],
+        "host": doc.get("host"),
+        "clock_offset_s": doc.get("clock_offset_s"),
+        "sample_n": doc.get("sample_n"),
+        "spans": len(doc.get("spans", [])),
+        "events": len(doc.get("events", [])),
+        "transitions": s.get("transitions", {}),
+    }
+
+
+def _print_host(out: Dict[str, Any]) -> None:
+    print(f"host ledger  {out['host']}  spans={out['spans']}  "
+          f"events={out['events']}  1/{out['sample_n']} sampled  "
+          f"clock_offset={out['clock_offset_s']}s")
+    for name in sorted(out["transitions"]):
+        t = out["transitions"][name]
+        print(f"  {name:24s} n={t['count']:<6d} p50={_fmt_s(t['p50'])} "
+              f"p99={_fmt_s(t['p99'])}")
+
+
+def _merge_dir(path: str) -> Dict[str, Any]:
+    """Re-merge the ``host-*.json`` per-host artifacts under ``path``."""
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    from go_libp2p_pubsub_tpu.obs.merge import merge_host_artifacts
+
+    files = sorted(glob.glob(os.path.join(path, "host-*.json")))
+    if not files:
+        raise OSError(f"no host-*.json artifacts under {path}")
+    arts = []
+    for f in files:
+        with open(f) as fh:
+            arts.append(json.load(fh))
+    return merge_host_artifacts(arts)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("artifact", help="path to a --trace-out JSON artifact")
+    ap.add_argument("artifact", nargs="?",
+                    help="path to a --trace-out JSON artifact")
+    ap.add_argument("--merge", metavar="DIR",
+                    help="merge per-host obs-span-host/1 artifacts "
+                         "(host-*.json) from DIR and summarize the result")
     ap.add_argument("--json", action="store_true",
                     help="emit the summary as JSON")
     args = ap.parse_args(argv)
+    if (args.artifact is None) == (args.merge is None):
+        ap.error("give exactly one of: an artifact path, or --merge DIR")
 
-    try:
-        with open(args.artifact) as fh:
-            doc = json.load(fh)
-    except (OSError, json.JSONDecodeError) as e:
-        print(f"error: cannot read {args.artifact}: {e}", file=sys.stderr)
-        return 2
+    if args.merge is not None:
+        try:
+            doc = _merge_dir(args.merge)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"error: cannot merge {args.merge}: {e}", file=sys.stderr)
+            return 2
+    else:
+        try:
+            with open(args.artifact) as fh:
+                doc = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"error: cannot read {args.artifact}: {e}", file=sys.stderr)
+            return 2
     fmt = doc.get("format") if isinstance(doc, dict) else None
 
     if fmt == "obs-span-artifact/1":
@@ -174,10 +281,19 @@ def main(argv=None) -> int:
         out = _blackbox_summary(doc)
         print(json.dumps(out, indent=1, sort_keys=True)) if args.json \
             else _print_blackbox(doc, out)
+    elif fmt == "obs-span-merged/1":
+        out = _merged_summary(doc)
+        print(json.dumps(out, indent=1, sort_keys=True)) if args.json \
+            else _print_merged(out)
+    elif fmt == "obs-span-host/1":
+        out = _host_summary(doc)
+        print(json.dumps(out, indent=1, sort_keys=True)) if args.json \
+            else _print_host(out)
     else:
         print(f"error: unknown artifact format {fmt!r} "
-              f"(expected obs-span-artifact/1, obs-record-trace/1, or "
-              f"obs-blackbox/1)", file=sys.stderr)
+              f"(expected obs-span-artifact/1, obs-record-trace/1, "
+              f"obs-blackbox/1, obs-span-merged/1, or obs-span-host/1)",
+              file=sys.stderr)
         return 2
     return 0
 
