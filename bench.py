@@ -142,6 +142,16 @@ LIVE_OBS_SCALE = dict(
 )
 LIVE_OBS_RUN_TIMEOUT_S = 600.0
 
+# Self-tuning controller A/B (BENCH_MODE=controller, r20): one run of the
+# drifting-workload canon (streaming_drifting_load) — the controller
+# closes the telemetry→knob loop over a pre-warmed three-rung geometry
+# ladder while the workload drifts through a ramp, a burst storm, and a
+# loss-regime shift — then one static twin per rung replays the identical
+# timeline.  The headline is the tuned-vs-best-static p99 ratio; the
+# canon run (tuned + 3 statics, all sharing one warm jit cache) takes
+# ~40s on CPU, so the budget is generous headroom, not expectation.
+CONTROLLER_RUN_TIMEOUT_S = 600.0
+
 PROBE_TIMEOUT_S = 180.0
 # The r3 TPU run took ~4.5 min, and the r5 child adds the device-kernel
 # scaling curve (4 compiled batch shapes) and the phase-breakdown compiles,
@@ -345,6 +355,21 @@ def _run_live_obs_child() -> dict:
     return {"error": f"live_obs attempt: {tail}"[:400]}
 
 
+def _run_controller_child() -> dict:
+    """Run the BENCH_MODE=controller child (self-tuned vs best-static
+    drifting-canon A/B).  The chunk walls the ratio compares are host
+    seconds on whatever backend serves the canon; CPU pin keeps the A/B
+    self-consistent with the canon suite.  Failure becomes an ``error``
+    dict, never a crash."""
+    parsed, tail = run_child(
+        {"BENCH_MODE": "controller", "JAX_PLATFORMS": "cpu"},
+        CONTROLLER_RUN_TIMEOUT_S,
+    )
+    if parsed is not None:
+        return parsed
+    return {"error": f"controller attempt: {tail}"[:400]}
+
+
 def orchestrate() -> None:
     attempts = []
     record = None
@@ -412,6 +437,12 @@ def orchestrate() -> None:
     if os.environ.get("BENCH_LIVE_OBS", "1") != "0":
         log("orchestrator: running live_obs child (BENCH_MODE=live_obs)")
         record["live_obs"] = _run_live_obs_child()
+
+    # Self-tuned vs best-static controller A/B rides along the same way
+    # (tools/perf_diff.py diffs it; BENCH_CONTROLLER=0 skips it).
+    if os.environ.get("BENCH_CONTROLLER", "1") != "0":
+        log("orchestrator: running controller child (BENCH_MODE=controller)")
+        record["controller"] = _run_controller_child()
 
     print(json.dumps(record))
 
@@ -1890,6 +1921,67 @@ def live_obs_child_main() -> None:
     print(json.dumps(record), flush=True)
 
 
+def controller_child_main() -> None:
+    """BENCH_MODE=controller: self-tuned vs best-static A/B (ISSUE 17 r20).
+
+    Runs the drifting-workload canon (diurnal ramp + burst storm +
+    loss-regime shift) through the streaming runner with the controller
+    closing the telemetry→knob loop over its pre-warmed geometry ladder,
+    then replays the identical timeline through one static twin per rung.
+    The headline is the tuned-vs-best-static p99 ratio (< 1.0 = the
+    closed loop beat every frozen configuration of the same engine);
+    knob changes, per-knob decision counts, and the zero-unplanned-
+    recompile assertion ride the record for tools/perf_diff.py."""
+    from go_libp2p_pubsub_tpu.scenario.canon import build
+    from go_libp2p_pubsub_tpu.scenario.streaming_runner import (
+        run_streaming_scenario,
+    )
+
+    spec = build("streaming_drifting_load")
+    t0 = time.perf_counter()
+    res = run_streaming_scenario(spec)
+    wall = time.perf_counter() - t0
+    ctl = res.engine_stats["controller"]
+    tuned_p99 = float(res.record["ingest_lat_p99_s"][-1])
+    record = {
+        "metric": "controller_p99_vs_best_static_ratio",
+        "value": round(float(ctl["p99_vs_best_static_ratio"]), 5),
+        "unit": "ratio",
+        "scenario": spec.name,
+        "verdict_passed": bool(res.verdict.passed),
+        "criteria": {
+            c.name: {"actual": c.actual, "threshold": c.threshold,
+                     "passed": c.passed}
+            for c in res.verdict.criteria
+        },
+        "ladder": ctl["ladder"],
+        "p99_vs_best_static_ratio": round(
+            float(ctl["p99_vs_best_static_ratio"]), 5
+        ),
+        "tuned_p99_s": round(tuned_p99, 6),
+        "tuned_p50_s": round(
+            float(res.record["ingest_lat_p50_s"][-1]), 6
+        ),
+        "best_static_p99_s": round(float(ctl["best_static_p99_s"]), 6),
+        "static": ctl["static"],
+        "knob_changes": int(ctl["decisions"]),
+        "decisions_by_knob": ctl["by_knob"],
+        "geometry_switches": int(ctl["geometry_switches"]),
+        "unplanned_recompiles": int(ctl["unplanned_recompiles"]),
+        "final_knobs": ctl["final_knobs"],
+        "completed": int(res.engine_stats["completed"]),
+        "wall_s": round(wall, 1),
+        "note": (
+            "drifting canon; self-tuned engine (geometry ladder + snapshot "
+            "cadence + watermarks) vs one frozen twin per ladder rung on "
+            "the identical timeline and loss regimes; ratio < 1.0 means "
+            "the closed loop beat every static configuration on p99 "
+            "ingest->delivery"
+        ),
+    }
+    print(json.dumps(record), flush=True)
+
+
 def child_main() -> None:
     mode = os.environ.get("BENCH_MODE", "tpu")
     if mode == "sharded":
@@ -1902,6 +1994,8 @@ def child_main() -> None:
         return streaming_child_main()
     if mode == "live_obs":
         return live_obs_child_main()
+    if mode == "controller":
+        return controller_child_main()
     scale = TPU_SCALE if mode == "tpu" else CPU_SCALE
 
     import jax

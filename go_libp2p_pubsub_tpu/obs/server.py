@@ -5,8 +5,9 @@ ingest ring, the watchdog, the validation pipeline, or a whole live
 network — rendered through ``render_prometheus``:
 
 - ``GET /metrics``    Prometheus text exposition (format 0.0.4);
-- ``GET /debug/obs``  JSON observability digest: span-ledger summary and
-  the black box's recent frames (when wired);
+- ``GET /debug/obs``  JSON observability digest: span-ledger summary, the
+  black box's recent frames, and the serving plane's live control surface
+  (controller knobs + watchdog tier + recent decisions) — when wired;
 - plus any ``extra_json`` endpoints the caller plugs in — the live plane
   mounts its ``/debug/tree`` topology snapshot here, so both planes share
   one serving path and one exposition formatter (the hand-rolled asyncio
@@ -37,10 +38,16 @@ class ObsHTTPServer:
         host: str = "127.0.0.1",
         port: int = 0,
         extra_json: Optional[Dict[str, Callable[[], object]]] = None,
+        controls: Optional[Callable[[], object]] = None,
     ) -> None:
         self.registry = registry
         self.ledger = ledger
         self.blackbox = blackbox
+        # r20: zero-arg callable returning the serving plane's live control
+        # surface (controller knobs, watchdog tier, recent decisions) —
+        # merged into /debug/obs as doc["controls"].  Typically
+        # serve.controller.Controller.controls.
+        self.controls = controls
         # path -> zero-arg callable returning a JSON-serializable doc,
         # rendered sorted-keys like /debug/obs.  Reserved paths lose.
         self.extra_json = dict(extra_json or {})
@@ -122,4 +129,6 @@ class ObsHTTPServer:
                 "recorded": self.blackbox.recorded,
                 "frames": self.blackbox.frames()[-8:],
             }
+        if self.controls is not None:
+            doc["controls"] = self.controls()
         return doc

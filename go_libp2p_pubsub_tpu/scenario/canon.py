@@ -746,6 +746,91 @@ def streaming_rlnc_crash_recovery() -> ScenarioSpec:
     )
 
 
+# Dedicated mesh for the self-tuning canon (r20).  A DISTINCT value from
+# _HYBRID_MESH on purpose: the drifting canon asserts
+# ``compile_cache_size() == ladder_size()`` over its whole run, and a mesh
+# value shared with another canon would let that canon's compiled chunk
+# leak into (or out of) the assertion.  msg_window=64 keeps every ladder
+# rung eviction-safe: the widest rung pops 32 slots per chunk, so a
+# message published late in a chunk survives at least one full boundary
+# before its slot cursor wraps — late-published burst tails fold their
+# completions instead of being evicted.
+_DRIFT_MESH = dict(n_peers=32, n_slots=8, conn_degree=6,
+                   msg_window=64, heartbeat_steps=4, gen_size=4,
+                   switch_hi=0.35, switch_lo=0.15)
+
+
+def streaming_drifting_load() -> ScenarioSpec:
+    """STREAMING-ONLY (hybrid plane, self-tuning): a drifting workload —
+    a 480-message burst storm early, a diurnal constant trickle, a ramp
+    doubling it, then a sustained loss-regime shift (ingress decimation
+    delay=3, deliveries stretch to ~5 rounds) — served by the controller
+    with a three-rung pre-warmed geometry ladder and an aggressive
+    initial durability posture (snapshot every chunk).
+
+    The comparative SLO is the whole point: the self-tuned engine must
+    beat EVERY static configuration of the same engine on p99
+    ingest→delivery.  The deciding phase is the burst: its tail latency
+    is a SUM of many chunk walls, so host-noise on individual walls
+    averages out and the gap between engines is structural, not lucky.
+    The wide rung drains the burst in ~15 chunks but pays the ~10 ms
+    every-chunk snapshot tax on each one (~150 ms of pure tax in the
+    tail); the narrow rung needs ~30 chunks AND pays the tax; the long
+    rung's per-message publish cost makes its burst chunks the most
+    expensive of all.  Only the tuned engine clears it on cheap walls:
+    it escalates to the wide rung on ring-depth pressure AND stretches
+    the snapshot cadence once the measured snapshot cost exceeds
+    ``snapshot_cost_frac`` of the chunk wall — decisions the statics by
+    definition cannot make.  The burst sits BEFORE the loss window so
+    every engine's burst tail drains on clean chunks; the loss phase
+    (decode cost is data-dependent and hits all geometries alike —
+    ~5 chunk walls per delivery) then multiplies the statics' snapshot
+    tax again, while never dominating the tuned engine's p99.  The long
+    rung is the carry escape hatch (``carry_up_chunks=8`` keeps it out
+    of this canon: loss carry tops out near 4) — present, pre-warmed,
+    asserted non-compiling, but never profitable here.  Zero unplanned
+    recompiles are graded over the WHOLE run including the static
+    twins, which reuse the tuned engine's model value and warm cache."""
+    return ScenarioSpec(
+        name="streaming_drifting_load",
+        family="hybrid",
+        n_steps=144,
+        seed=113,
+        model=dict(_DRIFT_MESH),
+        workloads=[
+            Workload(kind="constant", topic=0, start=0, stop=144, every=4),
+            Workload(kind="constant", topic=0, start=42, stop=56, every=4),
+            Workload(kind="burst", topic=0, n_msgs=480, start=16),
+        ],
+        streaming={
+            "streaming_only": True,
+            "chunk_steps": 4,
+            "pub_width": 4,
+            "capacity": 768,
+            "policy": "block",
+            "snapshot_every": 1,
+            "controller": {
+                "ladder": [[4, 4], [4, 8], [24, 1]],
+                "policy": {"carry_up_chunks": 8},
+            },
+            "compare_static": True,
+            "loss_regimes": [
+                {"start_step": 96, "stop_step": 140, "delay": 3},
+            ],
+        },
+        slo=SLO(
+            min_delivery_frac=0.97,
+            max_queue_depth=768,
+            max_silent_drops=0,
+            max_p99_vs_best_static_ratio=0.95,
+            min_controller_decisions=4,
+            max_unplanned_recompiles=0,
+        ),
+        description="Diurnal ramp + burst storm + loss-regime shift; the "
+                    "self-tuned engine must beat every static rung on p99.",
+    )
+
+
 CANON: Dict[str, Callable[[], ScenarioSpec]] = {
     "steady_state": steady_state,
     "flash_crowd": flash_crowd,
@@ -772,6 +857,7 @@ CANON: Dict[str, Callable[[], ScenarioSpec]] = {
     "streaming_verifier_crash": streaming_verifier_crash,
     "streaming_degraded_links": streaming_degraded_links,
     "streaming_rlnc_crash_recovery": streaming_rlnc_crash_recovery,
+    "streaming_drifting_load": streaming_drifting_load,
 }
 
 

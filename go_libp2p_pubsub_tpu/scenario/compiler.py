@@ -712,6 +712,12 @@ class StreamingPlan:
     # r16: hybrid plane — run an eager-forced twin over the same timeline
     # and report the p99 ingest->delivery ratio as a channel.
     compare_eager: bool = False
+    # r20: self-tuning — normalized {"ladder": [(steps, width), ...],
+    # "policy": {ControllerPolicy overrides}} when the spec asks for a
+    # controller, and the self-tuned-vs-best-static A/B flag (one static
+    # twin per ladder rung over the same timeline).
+    controller: Optional[Dict[str, Any]] = None
+    compare_static: bool = False
 
 
 def compile_streaming_plan(spec: ScenarioSpec) -> StreamingPlan:
@@ -750,6 +756,13 @@ def compile_streaming_plan(spec: ScenarioSpec) -> StreamingPlan:
     pub_width = int(cfg.get("pub_width", max(1, -(-capacity // chunk_steps))))
     completion_frac = float(cfg.get("completion_frac", 0.99))
     faults = _lower_streaming_faults(cfg, T, chunk_steps)
+    controller = _lower_controller(cfg, chunk_steps, pub_width)
+    compare_static = bool(cfg.get("compare_static", False))
+    if compare_static and controller is None:
+        raise ValueError(
+            "compare_static needs a \"controller\" dict (the static twins "
+            "are the ladder's rungs — nothing to compare without a ladder)"
+        )
     compare_eager = bool(cfg.get("compare_eager", False))
     if (compare_eager or "loss" in faults) and spec.family != "hybrid":
         raise ValueError(
@@ -809,7 +822,46 @@ def compile_streaming_plan(spec: ScenarioSpec) -> StreamingPlan:
         faults=faults,
         snapshot_every=snapshot_every,
         compare_eager=compare_eager,
+        controller=controller,
+        compare_static=compare_static,
     )
+
+
+def _lower_controller(
+    cfg: Dict[str, Any], chunk_steps: int, pub_width: int
+) -> Optional[Dict[str, Any]]:
+    """Validate the streaming dict's ``controller`` key: the geometry
+    ladder must contain the spec's base geometry (the pre-warm contract),
+    and policy overrides must name real :class:`ControllerPolicy` fields
+    with values its validation accepts — both checked at compile time, so
+    a bad spec fails before any engine warms."""
+    if cfg.get("controller") is None:
+        return None
+    from ..serve.tuning import ControllerPolicy, validate_ladder
+
+    ctl = dict(cfg["controller"])
+    unknown = set(ctl) - {"ladder", "policy"}
+    if unknown:
+        raise ValueError(
+            f"unknown controller keys {sorted(unknown)} "
+            "(expected \"ladder\" and optional \"policy\")"
+        )
+    ladder_cfg = ctl.get("ladder")
+    if not ladder_cfg:
+        raise ValueError("controller needs a non-empty \"ladder\"")
+    rungs = validate_ladder(
+        [tuple(int(x) for x in g) for g in ladder_cfg],
+        (chunk_steps, pub_width),
+    )
+    overrides = dict(ctl.get("policy") or {})
+    try:
+        ControllerPolicy(**overrides)
+    except TypeError as e:
+        raise ValueError(f"bad controller policy override: {e}") from None
+    return {
+        "ladder": [r.as_tuple() for r in rungs],
+        "policy": overrides,
+    }
 
 
 def _lower_streaming_faults(
@@ -874,4 +926,39 @@ def _lower_streaming_faults(
         faults["loss"] = {
             "start_chunk": start, "stop_chunk": stop, "delay": delay,
         }
+    if cfg.get("loss_regimes") is not None:
+        # r20 drifting-workload windows: STEP-keyed (not chunk-keyed) so
+        # the same spec is fair across chunk geometries — a controller
+        # switching rungs and a static twin see the loss start and stop at
+        # the same timeline steps.  Windows must be ordered and disjoint.
+        regimes: List[Dict[str, int]] = []
+        for i, rw in enumerate(cfg["loss_regimes"]):
+            rw = dict(rw)
+            start = int(rw.get("start_step", 0))
+            stop = int(rw.get("stop_step", n_steps))
+            delay = int(rw.get("delay", 1))
+            if delay < 1:
+                raise ValueError(
+                    f"loss_regimes[{i}].delay must be >= 1"
+                )
+            if not (0 <= start < stop <= n_steps):
+                raise ValueError(
+                    f"loss_regimes[{i}] window [{start}, {stop}) outside "
+                    f"the campaign's step range [0, {n_steps}]"
+                )
+            if regimes and start < regimes[-1]["stop_step"]:
+                raise ValueError(
+                    f"loss_regimes[{i}] starts at step {start}, inside the "
+                    f"previous window (ends {regimes[-1]['stop_step']}) — "
+                    "windows must be ordered and disjoint"
+                )
+            regimes.append(
+                {"start_step": start, "stop_step": stop, "delay": delay}
+            )
+        if "loss" in faults:
+            raise ValueError(
+                "\"loss\" (chunk-keyed) and \"loss_regimes\" (step-keyed) "
+                "stamp the same ingress-delay lever — use one or the other"
+            )
+        faults["loss_regimes"] = regimes
     return faults

@@ -262,6 +262,35 @@ def evaluate(
                 "p99_vs_eager_ratio", "max_p99_vs_eager_ratio"
             )[-1],
         ))
+    # Self-tuning criteria (r20): the controller runner's A/B channels.
+    # p99_vs_best_static_ratio < 1.0 asserts the self-tuned engine beat
+    # EVERY static rung of its own ladder on p99 ingest→delivery (0.0
+    # encodes "no static twin completed as many messages" — every static
+    # tail unboundedly worse); min_controller_decisions rejects a loop that
+    # never moved a knob; max_unplanned_recompiles grades the pre-warm
+    # contract, compile_cache_size() - ladder_size() over the WHOLE run.
+    if slo.max_p99_vs_best_static_ratio is not None:
+        crits.append(_crit(
+            "p99_vs_best_static_ratio", "max",
+            slo.max_p99_vs_best_static_ratio,
+            _streaming_channel(
+                "p99_vs_best_static_ratio", "max_p99_vs_best_static_ratio"
+            )[-1],
+        ))
+    if slo.min_controller_decisions is not None:
+        crits.append(_crit(
+            "controller_decisions", "min", slo.min_controller_decisions,
+            _streaming_channel(
+                "controller_decisions", "min_controller_decisions"
+            )[-1],
+        ))
+    if slo.max_unplanned_recompiles is not None:
+        crits.append(_crit(
+            "unplanned_recompiles", "max", slo.max_unplanned_recompiles,
+            _streaming_channel(
+                "unplanned_recompiles", "max_unplanned_recompiles"
+            )[-1],
+        ))
 
     return Verdict(
         scenario=spec.name,

@@ -281,6 +281,24 @@ class SLO:
     # when the eager twin completes FEWER messages than the hybrid the
     # ratio is reported as 0.0 (unboundedly worse eager tail).
     max_p99_vs_eager_ratio: Optional[float] = None
+    # Self-tuning criteria (r20, streaming runs with a ``controller`` dict
+    # and ``compare_static`` set — graded from the runner's
+    # ``p99_vs_best_static_ratio`` / ``controller_decisions`` /
+    # ``unplanned_recompiles`` channels).
+    # ``max_p99_vs_best_static_ratio``: ceiling on the self-tuned engine's
+    # p99 ingest→delivery divided by the BEST p99 any single static rung of
+    # the same ladder achieves over the same timeline — < 1.0 asserts the
+    # controller strictly beat every static configuration; when no static
+    # twin completes at least as many messages as the tuned engine the
+    # ratio is reported as 0.0 (every static tail is unboundedly worse).
+    # ``min_controller_decisions`` asserts the controller actually acted
+    # (no vacuous pass on a loop that never moved a knob);
+    # ``max_unplanned_recompiles`` is the pre-warm contract: the engine's
+    # ``compile_cache_size() - ladder_size()`` after the whole run,
+    # crash/restore included (0 = stepping the ladder never compiled).
+    max_p99_vs_best_static_ratio: Optional[float] = None
+    min_controller_decisions: Optional[int] = None
+    max_unplanned_recompiles: Optional[int] = None
 
 
 @dataclass
@@ -341,6 +359,28 @@ class ScenarioSpec:
     #                                 (switch thresholds pinned above 1.0)
     #                                 over the same timeline and emit the
     #                                 ``p99_vs_eager_ratio`` channel
+    #
+    # Self-tuning keys (r20 controller, both streaming families):
+    #   "controller": {"ladder": [[chunk_steps, pub_width], ...],
+    #                  "policy": {ControllerPolicy field overrides}} —
+    #                                 run with a serve.controller.Controller
+    #                                 polled at every chunk boundary over a
+    #                                 pre-warmed geometry ladder (must
+    #                                 contain the spec's base geometry);
+    #                                 zero unplanned recompiles is asserted
+    #                                 via the ``unplanned_recompiles``
+    #                                 channel
+    #   "compare_static": bool      — also replay the same timeline through
+    #                                 one STATIC twin engine per ladder rung
+    #                                 (controller disabled, no faults) and
+    #                                 emit ``p99_vs_best_static_ratio`` /
+    #                                 ``best_static_p99_s`` — the self-tuned
+    #                                 vs best-static A/B
+    #   "loss_regimes": [{"start_step": int, "stop_step": int,
+    #                     "delay": int}, ...] — step-keyed (NOT chunk-keyed:
+    #                                 fair across geometries) non-overlapping
+    #                                 ingress-delay windows; same per-family
+    #                                 delay semantics as "loss"
     streaming: Optional[Dict[str, Any]] = None
     slo: SLO = field(default_factory=SLO)
     description: str = ""

@@ -36,6 +36,15 @@ deterministically —
 Every streaming run emits ``recovery_s`` / ``lost_after_restart`` /
 ``duplicate_deliveries`` channels (zeros when unfaulted) so the crash SLOs
 always grade a real measurement.
+
+Self-tuning (r20): a ``controller`` block on the plan runs the campaign
+under a :class:`~..serve.controller.Controller` polled at every chunk
+boundary over a pre-warmed geometry ladder — the step pointer follows the
+engine's CURRENT chunk length, not the constructed one.  ``loss_regimes``
+are step-keyed ingress-delay windows (fair across geometries), and
+``compare_static`` replays the same timeline + regimes through one static
+twin per ladder rung (controller off, faults off) to emit the
+self-tuned-vs-best-static A/B channels the r20 SLOs grade.
 """
 
 from __future__ import annotations
@@ -141,15 +150,20 @@ def run_streaming_scenario(
     obs: Dict[str, Any] = {"ledger": None}
     obs_registry = None
     obs_blackbox = None
+    if tracing or plan.controller is not None:
+        from ..utils.metrics import MetricsRegistry
+
+        # One registry for the whole run (the monitoring plane survives
+        # engine crashes).  A controller run always gets one — the
+        # serve.controller.* / serve.watchdog.* gauges are part of the
+        # subsystem's contract, traced or not.
+        obs_registry = MetricsRegistry(clock=clock)
     if tracing:
         from ..obs.blackbox import BlackBox
         from ..obs.spans import SpanLedger
-        from ..utils.metrics import MetricsRegistry
 
-        # One registry + one black box for the whole run (the monitoring
-        # plane survives engine crashes); the span ledger is host state of
-        # the serving pair and is lost/restored WITH it.
-        obs_registry = MetricsRegistry(clock=clock)
+        # The black box rides the registry's lifetime; the span ledger is
+        # host state of the serving pair and is lost/restored WITH it.
         obs_blackbox = BlackBox(capacity=64, clock=clock)
         obs["ledger"] = SpanLedger(sample_n=trace_sample, clock=clock)
 
@@ -168,6 +182,9 @@ def run_streaming_scenario(
             clock=clock,
             snapshot_path=ckpt_path,
             snapshot_every=plan.snapshot_every,
+            geometry_ladder=(
+                plan.controller["ladder"] if plan.controller else None
+            ),
             metrics=obs_registry,
             tracer=obs["ledger"],
             blackbox=obs_blackbox,
@@ -192,8 +209,24 @@ def run_streaming_scenario(
         inside = loss_w["start_chunk"] <= ci < loss_w["stop_chunk"]
         eng.set_ingress_delay(loss_w["delay"] if inside else 0)
 
+    # r20 drifting-workload regimes: STEP-keyed windows (so a controller
+    # switching geometries and a static twin see the loss start and stop at
+    # the same timeline steps), stamped off the chunk's FIRST step before
+    # every dispatch.
+    regimes = faults.get("loss_regimes")
+
+    def _stamp_regime(eng, step: int) -> None:
+        if regimes is None:
+            return
+        delay = 0
+        for rw in regimes:
+            if rw["start_step"] <= step < rw["stop_step"]:
+                delay = rw["delay"]
+                break
+        eng.set_ingress_delay(delay)
+
     watchdog: Optional[Watchdog] = None
-    if "crash_at_chunk" in faults:
+    if "crash_at_chunk" in faults or plan.controller is not None:
         # Supervision is exercised through its public restart path; the
         # stall threshold is irrelevant under injected (not timed) crashes.
         watchdog = Watchdog(
@@ -238,6 +271,27 @@ def run_streaming_scenario(
 
     pipe = _mk_pipe()
 
+    controller = None
+    if plan.controller is not None:
+        from ..serve import Controller
+        from ..serve.tuning import ControllerPolicy
+
+        # The whole composed control surface: controller over engine +
+        # ring + watchdog + validation pipeline, sharing the run's clock,
+        # registry and ledger.  The ctor attaches itself to the watchdog,
+        # making KnobState the single source of truth for the policy its
+        # de-escalation restores.
+        controller = Controller(
+            engine,
+            ring,
+            policy=ControllerPolicy(**plan.controller["policy"]),
+            watchdog=watchdog,
+            pipe=pipe,
+            metrics=obs_registry,
+            tracer=obs["ledger"],
+            clock=clock,
+        )
+
     # Replay the timeline in chunk-sized groups: submit that group's
     # publishes through the crypto stage, flush (which enqueues), run one
     # resident chunk, sample depth.  Forged workloads (valid=False) are
@@ -258,9 +312,17 @@ def run_streaming_scenario(
     # producer keeps its own copies — as a real at-least-once client would).
     retry_window: List[List[Tuple[Any, Tuple[int, int]]]] = []
     T = spec.n_steps
-    for base in range(0, T, plan.chunk_steps):
+    base = 0
+    while base < T:
+        # The group spans the engine's CURRENT chunk length: under a
+        # controller the geometry — and so the number of timeline steps one
+        # dispatch advances — changes between chunks, and the step pointer
+        # must follow the engine, not the plan's constructed geometry.
+        # Without a controller this is plan.chunk_steps every iteration,
+        # bit-identical to the fixed-stride loop it replaces.
+        steps_this = engine.chunk_steps
         group: List[Tuple[Any, Tuple[int, int]]] = []
-        for t in range(base, min(base + plan.chunk_steps, T)):
+        for t in range(base, min(base + steps_this, T)):
             for topic, src, valid in plan.timeline[t]:
                 env = sign_envelope(
                     seed_bytes + src.to_bytes(4, "little") + b"\x00" * 20,
@@ -287,12 +349,17 @@ def run_streaming_scenario(
             pipe.drop_pending()
             pipe = _mk_pipe()
             pipeline_restarts += 1
+            if controller is not None:
+                # The flush-threshold knob must keep acting on the LIVE
+                # pipeline, not the dead one's corpse.
+                controller.pipe = pipe
             for g in retry_window:
                 for env, ctx in g:
                     pipe.submit(env, ctx=ctx)
         pipe.flush()
         depth_series.append(holder["ring"].depth)
         _stamp_loss(engine, chunk_index)
+        _stamp_regime(engine, base)
         engine.run_chunk()
         chunk_index += 1
         if faults.get("crash_at_chunk") == chunk_index:
@@ -315,8 +382,13 @@ def run_streaming_scenario(
                     f"post-crash warmup failed: {e}"
                 ) from e
             assert watchdog is not None
-            watchdog.engine = engine
-            watchdog.ring = ring
+            # reattach re-applies the current tier's shed set and policy to
+            # the fresh ring (a new ring is born un-escalated) and, with a
+            # controller, restores the DESIRED policy from its KnobState.
+            watchdog.reattach(engine, ring)
+            if controller is not None:
+                controller.reattach(engine, ring)
+                controller.tracer = obs["ledger"]
             info = watchdog.restart_engine(
                 f"injected engine crash after chunk {chunk_index}"
             )
@@ -331,8 +403,18 @@ def run_streaming_scenario(
         frac_series.append(
             engine.completed / max(1, len(engine.publish_log))
         )
+        if controller is not None:
+            # One supervision pass + one tuning pass per chunk boundary —
+            # the composed control surface in its polling order: the
+            # watchdog may escalate first, then the controller tunes
+            # (never writing the ring policy while tier 2 holds it).
+            watchdog.note_chunk()
+            watchdog.poll()
+            controller.poll()
+        base += steps_this
 
     _stamp_loss(engine, chunk_index)  # drain runs on clean fabric
+    _stamp_regime(engine, T)
     engine.run_until_drained(max_chunks=max_drain_chunks)
     acct = ring.accounting()
     lats = engine.latencies_s
@@ -406,6 +488,98 @@ def run_streaming_scenario(
         elif eager_p99 > 0.0 and np.isfinite(eager_p99):
             p99_ratio = q["p99"] / eager_p99
 
+    # compare_static (r20): the self-tuned-vs-best-static A/B.  One twin
+    # per ladder rung replays the SAME timeline under the SAME step-keyed
+    # loss regimes — the drifting adversity is the point — with the
+    # controller off and crash/verifier faults off, same fairness posture
+    # as the eager twin: publishes go straight to the ring with the spec's
+    # validity bit, so ingest stamps land at push in both runs.  The twins
+    # reuse the tuned engine's model VALUE, so every rung is already warm
+    # in the shared jit cache and the whole A/B adds zero compiles.
+    static_results: List[Dict[str, Any]] = []
+    best_static_p99 = float("nan")
+    p99_static_ratio = float("nan")
+    if plan.compare_static:
+        from ..serve import IngestRing as _SRing
+        from ..serve import StreamingEngine as _SEngine
+
+        assert plan.controller is not None  # compiler enforces the pairing
+        for steps_g, width_g in plan.controller["ladder"]:
+            sring = _SRing(
+                capacity=plan.capacity, policy=plan.policy, clock=clock
+            )
+            # The twin freezes EVERY knob at the tuned engine's initial
+            # configuration — including the snapshot cadence.  A twin that
+            # silently dropped the durability tax would be a different
+            # (cheaper, less safe) engine, not a static configuration of
+            # the same one.
+            sckpt = None
+            if ckpt_dir is not None and plan.snapshot_every > 0:
+                sckpt = os.path.join(
+                    ckpt_dir, f"static-{steps_g}x{width_g}.ckpt"
+                )
+            seng = _SEngine(
+                model,
+                sring,
+                chunk_steps=steps_g,
+                pub_width=width_g,
+                completion_frac=plan.completion_frac,
+                seed=spec.seed,
+                clock=clock,
+                snapshot_path=sckpt,
+                snapshot_every=plan.snapshot_every,
+            )
+            try:
+                seng.warmup()
+            except Exception as e:
+                raise StreamingPlaneError(
+                    f"static twin {steps_g}x{width_g} warmup failed: {e}"
+                ) from e
+            sseq = 0
+            sbase = 0
+            while sbase < T:
+                for t in range(sbase, min(sbase + steps_g, T)):
+                    for topic, src, valid in plan.timeline[t]:
+                        sring.push(
+                            topic=topic, payload=b"stream-%d" % sseq,
+                            publisher=src, valid=valid, timeout=5.0,
+                        )
+                        sseq += 1
+                _stamp_regime(seng, sbase)
+                seng.run_chunk()
+                sbase += steps_g
+            _stamp_regime(seng, T)
+            seng.run_until_drained(max_chunks=max_drain_chunks)
+            sq = seng.latency_quantiles()
+            static_results.append({
+                "geometry": [steps_g, width_g],
+                "p50_s": float(sq["p50"]),
+                "p99_s": float(sq["p99"]),
+                "completed": int(seng.completed),
+            })
+        # A static twin only competes on p99 if it finished at least as
+        # many messages as the tuned engine — a rung that never delivered
+        # the tail has an unboundedly worse p99, whatever it measured.
+        eligible = [
+            r["p99_s"] for r in static_results
+            if r["completed"] >= engine.completed and np.isfinite(r["p99_s"])
+        ]
+        if not eligible:
+            p99_static_ratio = 0.0
+        else:
+            best_static_p99 = min(eligible)
+            if best_static_p99 > 0.0:
+                p99_static_ratio = q["p99"] / best_static_p99
+
+    # The pre-warm contract, graded over the WHOLE run (warmup, controller
+    # switches, crash/restore, drain, static twins): the shared jit cache
+    # holds exactly the ladder's variants and nothing more.
+    unplanned_recompiles = 0
+    if plan.controller is not None:
+        unplanned_recompiles = (
+            engine.compile_cache_size() - engine.ladder_size()
+        )
+
     # Exactly-once floor: every admitted valid message must end the run
     # delivered, deduplicated, in flight, still queued, or attributed to a
     # named shed counter.  The residual is what the crash actually LOST.
@@ -445,6 +619,20 @@ def run_streaming_scenario(
     if plan.compare_eager:
         record["eager_p99_s"] = np.asarray([eager_p99], np.float64)
         record["p99_vs_eager_ratio"] = np.asarray([p99_ratio], np.float64)
+    if plan.controller is not None:
+        record["controller_decisions"] = np.asarray(
+            [len(controller.decisions)], np.int64
+        )
+        record["unplanned_recompiles"] = np.asarray(
+            [unplanned_recompiles], np.int64
+        )
+    if plan.compare_static:
+        record["best_static_p99_s"] = np.asarray(
+            [best_static_p99], np.float64
+        )
+        record["p99_vs_best_static_ratio"] = np.asarray(
+            [p99_static_ratio], np.float64
+        )
     verdict = slo_mod.evaluate(spec, record, plan.n_publishes)
     trace_summary: Optional[Dict[str, Any]] = None
     if tracing:
@@ -468,6 +656,24 @@ def run_streaming_scenario(
                     "chunk": q,
                     "exact": engine.latency_quantiles(mode="exact"),
                 },
+                "controls": (
+                    controller.controls() if controller is not None
+                    else None
+                ),
+                # The self-tuned-vs-static A/B headline, so the artifact
+                # answers "did the controller earn its keep" without the
+                # caller re-deriving it from the record arrays.
+                "controller": (
+                    {
+                        "tuned_p99_s": q["p99"],
+                        "best_static_p99_s": best_static_p99,
+                        "p99_vs_best_static_ratio": p99_static_ratio,
+                        "decisions": len(controller.decisions),
+                        "unplanned_recompiles": unplanned_recompiles,
+                    }
+                    if controller is not None and plan.compare_static
+                    else None
+                ),
             },
         )
         write_json(trace_out, artifact)
@@ -506,6 +712,32 @@ def run_streaming_scenario(
             "trace_out": trace_out,
             "trace_summary": trace_summary,
             "recovery_gap_s": engine.last_recovery_gap_s,
+            "controller": (
+                None if controller is None else {
+                    "decisions": len(controller.decisions),
+                    "by_knob": {
+                        k: sum(
+                            1 for d in controller.decisions if d.knob == k
+                        )
+                        for k in sorted(
+                            {d.knob for d in controller.decisions}
+                        )
+                    },
+                    "geometry_switches": engine.geometry_switches,
+                    "unplanned_recompiles": unplanned_recompiles,
+                    "ladder": [
+                        list(g.as_tuple()) for g in engine.ladder
+                    ],
+                    "final_knobs": controller.knobs.to_dict(),
+                    "watchdog_tier": (
+                        watchdog.tier_name if watchdog is not None
+                        else "normal"
+                    ),
+                    "static": static_results,
+                    "best_static_p99_s": best_static_p99,
+                    "p99_vs_best_static_ratio": p99_static_ratio,
+                }
+            ),
         },
         seconds=time.monotonic() - t0,
     )

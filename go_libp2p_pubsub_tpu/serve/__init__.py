@@ -11,16 +11,28 @@ Crash safety lives in the same package: the engine writes atomic durable
 snapshots and restores from them without recompiling (:mod:`.engine`),
 supervised by a fake-clock-testable watchdog that restarts wedged engines
 and walks explicit degradation tiers under overload (:mod:`.watchdog`).
+
+Self-tuning lives here too (r20): a poll-driven controller
+(:mod:`.controller`) closes the loop from the telemetry plane back to the
+runtime knobs — and steps a pre-warmed ladder of chunk geometries
+(:mod:`.tuning`) with zero unplanned recompiles.
 """
 
+from .controller import Controller
 from .engine import PendingMessage, StreamingEngine, content_hash
 from .ingest import BACKPRESSURE_POLICIES, IngestItem, IngestRing
+from .tuning import ChunkGeometry, ControllerPolicy, Decision, KnobState
 from .watchdog import TIER_NAMES, Watchdog
 
 __all__ = [
     "BACKPRESSURE_POLICIES",
+    "ChunkGeometry",
+    "Controller",
+    "ControllerPolicy",
+    "Decision",
     "IngestItem",
     "IngestRing",
+    "KnobState",
     "PendingMessage",
     "StreamingEngine",
     "TIER_NAMES",

@@ -70,12 +70,16 @@ from ..ops import schedule as sched
 from ..utils import checkpoint as ckpt
 from ..utils.trace import xla_trace
 from .ingest import IngestItem, IngestRing
+from .tuning import ChunkGeometry, validate_ladder
 
 # The resident program per model VALUE (models define __eq__/__hash__ over
 # their config).  Keyed here — not per-engine — so the crash-restart path
 # (fresh engine over an equal model) shares the compiled chunk instead of
-# paying a recompile.  Engines sharing a model must use identical
-# (chunk_steps, pub_width) for compile_cache_size() to stay 1.
+# paying a recompile.  Engines sharing a model must keep their
+# (chunk_steps, pub_width) shapes inside ONE pre-declared geometry ladder:
+# each rung is a compiled variant of the same jitted rollout, so
+# compile_cache_size() == ladder size (1 without a ladder) is the
+# zero-unplanned-recompiles contract the tests assert.
 _ROLLOUT_CACHE: Dict[MultiTopicGossipSub, object] = {}
 
 
@@ -130,6 +134,7 @@ class StreamingEngine:
         blackbox=None,
         profile_every: int = 0,
         profile_dir: Optional[str] = None,
+        geometry_ladder=None,
     ) -> None:
         if chunk_steps < 1 or pub_width < 1:
             raise ValueError("chunk_steps and pub_width must be >= 1")
@@ -147,6 +152,15 @@ class StreamingEngine:
         self.ring = ring
         self.chunk_steps = chunk_steps
         self.pub_width = pub_width
+        # Pre-declared chunk geometries (r20 self-tuning): the constructed
+        # (chunk_steps, pub_width) must be a rung; warmup() compiles every
+        # rung so set_geometry() later switches between ALREADY-compiled
+        # variants — the chunk-shape knob without an unplanned recompile.
+        self.ladder = validate_ladder(
+            geometry_ladder if geometry_ladder is not None
+            else [(chunk_steps, pub_width)],
+            (chunk_steps, pub_width),
+        )
         self.completion_frac = completion_frac
         self.metrics = metrics
         self._clock = clock
@@ -177,6 +191,12 @@ class StreamingEngine:
         self.publish_log: List[PendingMessage] = []   # every VALID publish
         self.invalid_published: List[Tuple[int, int]] = []  # (topic, slot)
         self.chunks_run = 0
+        # Device rounds advanced so far — an explicit accumulator, NOT
+        # chunks_run * chunk_steps, because ladder switches make chunks
+        # variable-length (step_published must stay device-exact across
+        # geometry changes for the exact-latency interpolation).
+        self.steps_run = 0
+        self.geometry_switches = 0
         self.published = 0
         self.completed = 0
         self.evicted = 0       # window slot recycled before completion
@@ -200,23 +220,82 @@ class StreamingEngine:
     # -- lifecycle ----------------------------------------------------------
 
     def warmup(self) -> None:
-        """Run one all-quiet chunk to pay the compile before traffic
-        arrives (the serving analog of the bench's compile+warm pass).
-        Advances the device state by ``chunk_steps`` idle rounds.
+        """Run one all-quiet chunk PER LADDER RUNG to pay every compile
+        before traffic arrives (the serving analog of the bench's
+        compile+warm pass), ending on the constructed geometry.  Advances
+        the device state by the ladder's total idle rounds.
 
         Warmup chunks never auto-snapshot: on the crash-restart path a
         fresh engine warms up *before* ``restore()``, and an auto-snapshot
         here would clobber the very checkpoint it is about to restore."""
+        base = (self.chunk_steps, self.pub_width)
         self._in_warmup = True
         try:
+            # Base rung last, so the engine exits warmup on its
+            # constructed geometry with a matching flight tail.
+            for g in self.ladder:
+                if g.as_tuple() == base:
+                    continue
+                self.chunk_steps, self.pub_width = g.as_tuple()
+                self._dispatch(self._empty_events())
+            self.chunk_steps, self.pub_width = base
             self._dispatch(self._empty_events())
+            # The completion fold is its own jitted function, first called
+            # when a real chunk folds — pay that compile here too, or the
+            # first traffic-bearing chunk eats a ~100ms stall and the
+            # message riding it walks straight into the latency p99.
+            jax.device_get(self.model.stream_digest(self.state))
+            if self.snapshot_path is not None:
+                # Same reasoning for the checkpoint path: the first
+                # serialization of the full state is cold (~100ms) and
+                # auto-snapshots run inside run_chunk's wall.  Warm it
+                # against memory only — warmup must never write
+                # snapshot_path (see the restore note above).
+                ckpt.warm_serialize(
+                    {"state": self.state,
+                     "flight_tail": dict(self.flight_tail)}
+                )
         finally:
             self._in_warmup = False
 
     def compile_cache_size(self) -> int:
-        """Number of compiled variants of the resident chunk — 1 after
-        warmup, and STILL 1 after any number of chunks, or shapes drifted."""
+        """Number of compiled variants of the resident chunk — the ladder
+        size (1 without a ladder) after warmup, and STILL the ladder size
+        after any number of chunks, geometry switches, or crash/restore
+        cycles — or shapes drifted (an unplanned recompile)."""
         return self._rollout._cache_size()
+
+    def ladder_size(self) -> int:
+        """Number of pre-warmed chunk geometries (1 without a ladder) —
+        the value ``compile_cache_size()`` must equal after warmup."""
+        return len(self.ladder)
+
+    @property
+    def geometry(self) -> ChunkGeometry:
+        return ChunkGeometry(self.chunk_steps, self.pub_width)
+
+    def set_geometry(self, chunk_steps: int, pub_width: int) -> None:
+        """Switch the NEXT chunk's shape to another pre-warmed rung (chunk
+        boundaries only — the caller is the serving loop, which only holds
+        the engine between ``run_chunk`` calls).  Raises on a geometry
+        that is not on the ladder: switching would compile a new variant,
+        which is exactly the unplanned recompile this API exists to
+        prevent."""
+        want = (int(chunk_steps), int(pub_width))
+        if want == (self.chunk_steps, self.pub_width):
+            return
+        if want not in {g.as_tuple() for g in self.ladder}:
+            raise ValueError(
+                f"geometry {want} is not on the pre-warmed ladder "
+                f"{[g.as_tuple() for g in self.ladder]}; switching would "
+                "recompile"
+            )
+        self.chunk_steps, self.pub_width = want
+        self.geometry_switches += 1
+        if self.metrics is not None:
+            self.metrics.inc("serve.engine.geometry_switches")
+            self.metrics.gauge("serve.engine.chunk_steps", self.chunk_steps)
+            self.metrics.gauge("serve.engine.pub_width", self.pub_width)
 
     # -- the chunk loop -----------------------------------------------------
 
@@ -225,7 +304,7 @@ class StreamingEngine:
         rounds, and fold completions.  Returns a host-side summary."""
         events = self._empty_events()
         items = self.ring.pop_batch(self.chunk_steps * self.pub_width)
-        base_step = self.chunks_run * self.chunk_steps
+        base_step = self.steps_run
         t_dispatch = self._clock()
         cursor = 0
         for item in items:
@@ -319,6 +398,7 @@ class StreamingEngine:
             "pub_width": self.pub_width,
             "completion_frac": self.completion_frac,
             "chunks_run": self.chunks_run,
+            "steps_run": self.steps_run,
             "published": self.published,
             "completed": self.completed,
             "evicted": self.evicted,
@@ -396,16 +476,22 @@ class StreamingEngine:
                 "checkpoint/model config mismatch: "
                 f"snapshot={meta['model']!r} engine={self._model_key()!r}"
             )
-        if (
-            int(meta["chunk_steps"]) != self.chunk_steps
-            or int(meta["pub_width"]) != self.pub_width
-        ):
-            raise ValueError(
-                "checkpoint chunk shapes "
-                f"({meta['chunk_steps']}x{meta['pub_width']}) != engine "
-                f"({self.chunk_steps}x{self.pub_width}); restoring would "
-                "break the one-compiled-variant contract"
-            )
+        snap_geom = (int(meta["chunk_steps"]), int(meta["pub_width"]))
+        if snap_geom != (self.chunk_steps, self.pub_width):
+            # A ladder engine adopts the snapshot's geometry (the rung the
+            # controller had selected at checkpoint time) — it is already
+            # compiled, so the switch costs nothing.  Off-ladder shapes
+            # still refuse: restoring would compile a new variant.
+            if snap_geom in {g.as_tuple() for g in self.ladder}:
+                self.set_geometry(*snap_geom)
+            else:
+                raise ValueError(
+                    "checkpoint chunk shapes "
+                    f"({meta['chunk_steps']}x{meta['pub_width']}) not on "
+                    f"the engine's ladder "
+                    f"{[g.as_tuple() for g in self.ladder]}; restoring "
+                    "would break the pre-warmed-variants contract"
+                )
         tree = ckpt.restore(
             path, {"state": self.state, "flight_tail": dict(self.flight_tail)}
         )
@@ -416,6 +502,11 @@ class StreamingEngine:
         }
         self.completion_frac = float(meta["completion_frac"])
         self.chunks_run = int(meta["chunks_run"])
+        # Pre-ladder checkpoints (constant geometry) reconstruct the step
+        # accumulator the way the old code computed base_step.
+        self.steps_run = int(meta.get(
+            "steps_run", self.chunks_run * int(meta["chunk_steps"])
+        ))
         self.published = int(meta["published"])
         self.completed = int(meta["completed"])
         self.evicted = int(meta["evicted"])
@@ -562,6 +653,7 @@ class StreamingEngine:
         digest = jax.device_get(self.model.stream_digest(self.state))
         t_done = self._clock()
         self.chunks_run += 1
+        self.steps_run += self.chunk_steps
         self.last_chunk_wall_s = t_done - t_start
         deliver_steps = (
             np.asarray(jax.device_get(deliver_dev))

@@ -109,6 +109,12 @@ class IngestRing:
         self._block_waits = 0
         self._shed_topics: FrozenSet[int] = frozenset()
         self._shed_priority = 0
+        # The active policy is a gauge from birth (r20): its index in
+        # BACKPRESSURE_POLICIES, so /metrics shows which backpressure mode
+        # is live without scraping tier logs.
+        self._metric_gauge(
+            "serve.ingest.policy", BACKPRESSURE_POLICIES.index(policy)
+        )
 
     # -- producer side ------------------------------------------------------
 
@@ -213,6 +219,9 @@ class IngestRing:
             )
         with self._lock:
             self.policy = policy
+            self._metric_gauge(
+                "serve.ingest.policy", BACKPRESSURE_POLICIES.index(policy)
+            )
             # Leaving `block` must release anyone parked on the condition so
             # they re-evaluate under the new policy.
             self._not_full.notify_all()

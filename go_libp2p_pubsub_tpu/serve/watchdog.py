@@ -27,9 +27,13 @@ flush.  ``poll()`` then:
    Tier 1 installs the ring's shed set (topics below the top priority are
    refused at the door, each refusal counted under ``shed_priority`` in the
    conservation ledger).  Tier 2 additionally swaps the backpressure policy
-   to ``drop_oldest`` (freshest-wins), restoring the original policy on the
-   way back down.  Every shed is loudly attributed — the ledger's
-   ``silent_drops`` stays zero through every tier.
+   to ``drop_oldest`` (freshest-wins), restoring the *currently desired*
+   policy on the way back down: with a :mod:`.controller` attached, that is
+   the controller's ``KnobState.backpressure_policy`` — the single source
+   of truth — so a controller retune that happened mid-escalation is never
+   reverted to a stale construction-time policy.  Every shed is loudly
+   attributed — the ledger's ``silent_drops`` stays zero through every
+   tier.
 """
 
 from __future__ import annotations
@@ -110,11 +114,19 @@ class Watchdog:
         self.postmortem_path = postmortem_path
         self.tier = 0
         self._orig_policy = ring.policy
+        # Attached by serve.controller.Controller: when present, the
+        # controller's KnobState is the single source of truth for the
+        # desired backpressure policy (see _desired_policy).
+        self.controller = None
         self._last_chunk: Optional[float] = None
         self._last_verifier: Optional[float] = None
         self.engine_restarts = 0
         self.verifier_restarts = 0
         self.tier_log: List[Tuple[float, str, str]] = []  # (t, tier, reason)
+        if self.metrics is not None:
+            # The tier is a gauge from birth (r20): /metrics shows
+            # "normal" as an explicit 0, not an absent family.
+            self.metrics.gauge("serve.watchdog.tier", self.tier)
 
     # -- liveness stamps (called by the serving loop) -----------------------
 
@@ -199,11 +211,34 @@ class Watchdog:
             self.on_engine_restart(info)
         return info
 
+    def reattach(self, engine, ring) -> None:
+        """Point supervision at a replacement engine+ring pair (the staged
+        crash path discards both) and RE-APPLY the current tier's controls
+        to the new ring — a fresh ring is born with no shed set and its
+        constructed policy, which under an active escalation would silently
+        exit the tier the ladder decided on."""
+        self.engine = engine
+        self.ring = ring
+        if self.tier >= 1:
+            ring.set_shed_topics(self._shed_set)
+        if self.tier >= 2:
+            ring.set_policy("drop_oldest")
+        else:
+            ring.set_policy(self._desired_policy())
+
     @property
     def tier_name(self) -> str:
         return TIER_NAMES[self.tier]
 
     # -- internals -----------------------------------------------------------
+
+    def _desired_policy(self) -> str:
+        """The policy de-escalation restores: the controller's current
+        desired policy when one is attached (single source of truth —
+        satellite fix r20), else the policy memorized at construction."""
+        if self.controller is not None:
+            return self.controller.knobs.backpressure_policy
+        return self._orig_policy
 
     def _set_tier(self, tier: int, reason: str) -> None:
         self.tier = tier
@@ -214,7 +249,7 @@ class Watchdog:
         if tier >= 2:
             self.ring.set_policy("drop_oldest")
         else:
-            self.ring.set_policy(self._orig_policy)
+            self.ring.set_policy(self._desired_policy())
         self.tier_log.append((self.clock(), TIER_NAMES[tier], reason))
         self._inc("serve.watchdog.tier_changes")
         if self.metrics is not None:

@@ -1,0 +1,542 @@
+"""Self-tuning serving plane: tuning data, the pre-warmed geometry ladder,
+and the controller's telemetry->knob loop.
+
+The contracts under test, in order of importance:
+
+1. The pre-warm contract: an engine built with a geometry ladder compiles
+   EXACTLY ``ladder_size()`` rollout variants during warmup, and that count
+   never grows — not across chunks, not across ``set_geometry`` switches;
+   an off-ladder switch raises instead of recompiling.
+2. Each knob mover fires on its documented evidence and on nothing else:
+   geometry on depth/carry pressure (hysteretic de-escalation), snapshot
+   cadence on checkpoint-wall fraction (tighten-to-floor on restore),
+   flush threshold only while it BINDS, backpressure on producer waits at
+   a full ring.
+3. Every decision is recorded with its triggering evidence and stamped
+   into the span ledger as a ``controller_decision`` event.
+4. The watchdog + controller compose through ``KnobState``: de-escalation
+   restores the controller's CURRENT desired policy, not the one the
+   watchdog memorized at construction; ``reattach`` re-applies the tier's
+   controls to a fresh ring.
+5. The spec/compiler lowering and the drifting canon's registration.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from go_libp2p_pubsub_tpu import scenario
+from go_libp2p_pubsub_tpu.models.multitopic import MultiTopicGossipSub
+from go_libp2p_pubsub_tpu.obs.spans import SpanLedger
+from go_libp2p_pubsub_tpu.serve import (
+    ChunkGeometry,
+    Controller,
+    ControllerPolicy,
+    IngestRing,
+    KnobState,
+    StreamingEngine,
+    Watchdog,
+)
+from go_libp2p_pubsub_tpu.serve.tuning import validate_ladder
+from go_libp2p_pubsub_tpu.utils.metrics import MetricsRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_TINY = dict(n_topics=2, n_peers=16, n_slots=8, conn_degree=4,
+             msg_window=16, heartbeat_steps=4)
+
+# The tiny ladder: calm rung (6,2), wide rung (6,4), long rung (12,1).
+_LADDER = [(6, 2), (6, 4), (12, 1)]
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    return MultiTopicGossipSub(**_TINY)
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _engine(model, **kw):
+    ring = IngestRing(capacity=kw.pop("capacity", 32),
+                      policy=kw.pop("policy", "block"),
+                      metrics=kw.get("metrics"))
+    kw.setdefault("chunk_steps", 6)
+    kw.setdefault("pub_width", 2)
+    kw.setdefault("geometry_ladder", _LADDER)
+    return StreamingEngine(model, ring, **kw), ring
+
+
+# ---------------------------------------------------------------------------
+# tuning data
+# ---------------------------------------------------------------------------
+
+
+def test_chunk_geometry_validation():
+    g = ChunkGeometry(6, 4)
+    assert g.slots == 24 and g.as_tuple() == (6, 4)
+    with pytest.raises(ValueError):
+        ChunkGeometry(0, 4)
+    with pytest.raises(ValueError):
+        ChunkGeometry(6, 0)
+
+
+def test_validate_ladder_normalizes_and_rejects():
+    rungs = validate_ladder([(6, 2), ChunkGeometry(6, 4)], base=(6, 2))
+    assert [r.as_tuple() for r in rungs] == [(6, 2), (6, 4)]
+    with pytest.raises(ValueError, match="duplicate"):
+        validate_ladder([(6, 2), (6, 2)], base=(6, 2))
+    with pytest.raises(ValueError, match="not on the ladder"):
+        validate_ladder([(6, 2)], base=(4, 4))
+    with pytest.raises(ValueError, match="at least one"):
+        validate_ladder([], base=(6, 2))
+
+
+def test_controller_policy_validation():
+    ControllerPolicy()  # defaults are self-consistent
+    with pytest.raises(ValueError):
+        ControllerPolicy(depth_down_frac=0.8, depth_up_frac=0.5)
+    with pytest.raises(ValueError):
+        ControllerPolicy(carry_up_chunks=0)
+    with pytest.raises(ValueError):
+        ControllerPolicy(snapshot_every_min=4, snapshot_every_max=2)
+    with pytest.raises(ValueError):
+        ControllerPolicy(flush_threshold_min=0)
+    with pytest.raises(ValueError):
+        ControllerPolicy(watermark_high_chunks=0.25)
+
+
+# ---------------------------------------------------------------------------
+# the pre-warmed ladder (the zero-unplanned-recompiles contract)
+# ---------------------------------------------------------------------------
+
+
+def test_ladder_warmup_cache_equals_ladder_size(tiny_model):
+    eng, ring = _engine(tiny_model)
+    eng.warmup()
+    assert eng.ladder_size() == len(_LADDER)
+    assert eng.compile_cache_size() == eng.ladder_size()
+    # Chunks + every on-ladder switch never grow the cache.
+    for steps, width in [(6, 4), (12, 1), (6, 2)]:
+        eng.set_geometry(steps, width)
+        ring.push(topic=0, payload=bytes([steps, width]), publisher=1)
+        eng.run_chunk()
+        assert eng.compile_cache_size() == eng.ladder_size()
+    assert eng.geometry_switches == 3
+
+
+def test_set_geometry_off_ladder_raises(tiny_model):
+    eng, _ = _engine(tiny_model)
+    eng.warmup()
+    with pytest.raises(ValueError, match="not on the pre-warmed ladder"):
+        eng.set_geometry(7, 3)
+    assert eng.compile_cache_size() == eng.ladder_size()
+
+
+# ---------------------------------------------------------------------------
+# the knob movers, one evidence branch at a time (fake clock throughout)
+# ---------------------------------------------------------------------------
+
+
+def test_geometry_escalates_on_depth_and_returns_hysteretically(tiny_model):
+    clock = FakeClock()
+    ledger = SpanLedger(clock=clock)
+    eng, ring = _engine(tiny_model)
+    eng.warmup()
+    wd = Watchdog(eng, ring, chunk_stall_s=1e9, high_watermark=30,
+                  low_watermark=2, clock=clock)
+    ctl = Controller(eng, ring, watchdog=wd, tracer=ledger, clock=clock)
+    # Backlog beyond depth_up_frac * 12 slots: escalate to the WIDEST rung.
+    for i in range(16):
+        ring.push(topic=0, payload=bytes([i]), publisher=i % 8)
+    dec = ctl.poll()
+    assert eng.geometry.as_tuple() == (6, 4)
+    knobs = {d.knob for d in dec}
+    assert "geometry" in knobs
+    # The watchdog watermarks follow the new drain rate (composed
+    # surface); the high mark is clamped to the ring capacity.
+    assert "watermarks" in knobs
+    assert wd.high_watermark == 32 and wd.low_watermark == 12
+    geo = [d for d in dec if d.knob == "geometry"][0]
+    assert geo.evidence["depth"] == 16 and "slots" in geo.evidence
+    # The decision is on the span ledger with its evidence attached.
+    evs = [e for e in ledger.events() if e["name"] == "controller_decision"]
+    assert any(e["knob"] == "geometry" and e["ev_depth"] == 16 for e in evs)
+    # Drain, then require cooldown_polls consecutive calm polls.
+    while ring.depth:
+        eng.run_chunk()
+    while eng.pending:
+        eng.run_chunk()
+    assert ctl.poll() == []                       # calm poll 1 of 2
+    dec2 = ctl.poll()                             # calm poll 2: de-escalate
+    assert eng.geometry.as_tuple() == (6, 2)
+    assert [d.knob for d in dec2][0] == "geometry"
+
+
+def test_geometry_escalates_on_carry_to_longest_rung(tiny_model):
+    clock = FakeClock()
+    eng, ring = _engine(tiny_model)
+    eng.warmup()
+    ctl = Controller(
+        eng, ring, policy=ControllerPolicy(carry_up_chunks=2), clock=clock
+    )
+    # A pending message that survives >= 2 chunk boundaries is the
+    # loss-regime signature: the controller picks the LONGEST rung.  Carry
+    # is pure host accounting (pending keys aged against the chunk
+    # counter), so the test scripts it directly — the ingress-delay fault
+    # that produces it for real is hybrid-family (the drifting canon).
+    eng.pending[(0, 7)] = "stuck"
+    ctl.poll()                    # first observed: carry 0
+    eng.chunks_run += 1
+    ctl.poll()                    # survived one boundary: carry 1
+    eng.chunks_run += 1
+    ctl.poll()                    # carry 2 >= carry_up_chunks: escalate
+    assert eng.geometry.as_tuple() == (12, 1)
+    reasons = [d.reason for d in ctl.decisions if d.knob == "geometry"]
+    assert any("carry" in r for r in reasons)
+
+
+def test_snapshot_cadence_stretches_and_tightens_on_restore(
+        tiny_model, tmp_path):
+    clock = FakeClock()
+    path = str(tmp_path / "eng.ckpt")
+    eng, ring = _engine(tiny_model, snapshot_path=path, snapshot_every=1)
+    eng.warmup()
+    ring.push(topic=0, payload=b"a", publisher=1)
+    eng.run_chunk()
+    ctl = Controller(eng, ring, clock=clock)
+    # Checkpoint wall dominating the chunk wall -> stretch (doubling,
+    # bounded by snapshot_every_max). Host-side telemetry is injectable.
+    eng.last_chunk_wall_s = 0.010
+    eng.snapshots_taken, eng.snapshot_seconds = 2, 0.040   # avg 20ms
+    seen = []
+    for _ in range(4):
+        seen += [d for d in ctl.poll() if d.knob == "snapshot_every"]
+    assert eng.snapshot_every == ControllerPolicy().snapshot_every_max
+    assert [(d.old, d.new) for d in seen] == [(1, 2), (2, 4), (4, 8)]
+    # A restore tightens straight back to the floor: durability is
+    # cheapest right after paying for its absence.
+    eng.restores += 1
+    dec = [d for d in ctl.poll() if d.knob == "snapshot_every"]
+    assert eng.snapshot_every == 1
+    assert dec and "restore observed" in dec[0].reason
+    # Cheap checkpoints (< frac/4) never re-stretch from the floor.
+    eng.snapshots_taken, eng.snapshot_seconds = 100, 0.001
+    assert [d for d in ctl.poll() if d.knob == "snapshot_every"] == []
+
+
+class _FakePipe:
+    def __init__(self, flush_threshold=256):
+        self.flush_threshold = flush_threshold
+
+
+def test_flush_threshold_moves_only_while_binding(tiny_model):
+    clock = FakeClock()
+    reg = MetricsRegistry(clock=clock)
+    eng, ring = _engine(tiny_model, metrics=reg)
+    eng.warmup()
+    pipe = _FakePipe(flush_threshold=256)
+    ctl = Controller(eng, ring, pipe=pipe, metrics=reg, clock=clock)
+    eng.last_chunk_wall_s = 0.010
+    # Not binding: the last verify batch never filled the threshold, so a
+    # huge verify wall is attributed to the CALLER's flush cadence.
+    reg.gauge("crypto.pipeline.batch", 40)
+    reg.gauge("crypto.pipeline.verify_s", 0.100)
+    assert ctl.poll() == []
+    assert pipe.flush_threshold == 256
+    # Binding + verify wall dominating the chunk wall: split batches.
+    reg.gauge("crypto.pipeline.batch", 256)
+    dec = ctl.poll()
+    assert pipe.flush_threshold == 128
+    assert [d.knob for d in dec] == ["flush_threshold"]
+    # Binding + verify nearly free: regroup larger (bounded doubling).
+    reg.gauge("crypto.pipeline.batch", 128)
+    reg.gauge("crypto.pipeline.verify_s", 0.0001)
+    ctl.poll()
+    assert pipe.flush_threshold == 256
+
+
+class _WaitsRing(IngestRing):
+    """A ring whose block_waits counter the test scripts directly."""
+
+    def force_waits(self, n):
+        self._block_waits = n
+
+
+def test_backpressure_fails_fast_then_restores(tiny_model):
+    clock = FakeClock()
+    ring = _WaitsRing(capacity=4, policy="block")
+    eng = StreamingEngine(tiny_model, ring, chunk_steps=6, pub_width=2,
+                          geometry_ladder=[(6, 2)])
+    eng.warmup()
+    ctl = Controller(eng, ring, clock=clock)
+    for i in range(4):
+        ring.push(topic=0, payload=bytes([i]), publisher=i)
+    ring.force_waits(3)
+    dec = ctl.poll()
+    assert ring.policy == "reject"
+    assert ctl.knobs.backpressure_policy == "reject"
+    bp = [d for d in dec if d.knob == "backpressure_policy"]
+    assert bp and "fail fast" in bp[0].reason
+    # Depth back under depth_down_frac * capacity: restore the spec's
+    # configured policy.
+    while ring.depth:
+        eng.run_chunk()
+    while eng.pending:
+        eng.run_chunk()
+    ctl.poll()
+    assert ring.policy == "block"
+    assert ctl.knobs.backpressure_policy == "block"
+
+
+# ---------------------------------------------------------------------------
+# watchdog composition: KnobState is the single source of truth
+# ---------------------------------------------------------------------------
+
+
+def test_deescalation_restores_controller_desired_policy(tiny_model):
+    """The r20 satellite fix: the watchdog's tier-2 exit must restore the
+    controller's CURRENT desired policy, not the construction-time one."""
+    clock = FakeClock()
+    eng, ring = _engine(tiny_model, capacity=32)
+    eng.warmup()
+    wd = Watchdog(eng, ring, chunk_stall_s=1e9, high_watermark=8,
+                  low_watermark=2, clock=clock)
+    ctl = Controller(eng, ring, watchdog=wd, clock=clock)
+    assert wd.controller is ctl
+    # The controller retunes its desired policy mid-run...
+    ctl.knobs.backpressure_policy = "reject"
+    # ...then overload escalates the watchdog to tier 2 (drop_oldest owns
+    # the live ring while escalated).
+    for i in range(10):
+        ring.push(topic=0, payload=bytes([i]), publisher=i % 8)
+    wd.poll()
+    wd.poll()
+    assert wd.tier == 2 and ring.policy == "drop_oldest"
+    # While tier 2 holds the ring, the controller never writes the live
+    # policy — its desire lands in KnobState only.
+    ring.pop_batch(64)
+    wd.poll()   # tier 2 -> 1
+    wd.poll()   # tier 1 -> 0: restore the DESIRED policy
+    assert wd.tier == 0
+    assert ring.policy == "reject"
+
+
+def test_deescalation_without_controller_restores_constructed(tiny_model):
+    eng, ring = _engine(tiny_model, capacity=32)
+    eng.warmup()
+    wd = Watchdog(eng, ring, chunk_stall_s=1e9, high_watermark=8,
+                  low_watermark=2, clock=FakeClock())
+    for i in range(10):
+        ring.push(topic=0, payload=bytes([i]), publisher=i % 8)
+    wd.poll(); wd.poll()
+    assert wd.tier == 2
+    ring.pop_batch(64)
+    wd.poll(); wd.poll()
+    assert wd.tier == 0 and ring.policy == "block"
+
+
+def test_reattach_reapplies_tier_and_keeps_decisions(tiny_model):
+    clock = FakeClock()
+    eng, ring = _engine(tiny_model, capacity=32)
+    eng.warmup()
+    wd = Watchdog(eng, ring, chunk_stall_s=1e9, high_watermark=8,
+                  low_watermark=2,
+                  topic_priority=[1, 0], clock=clock)
+    ctl = Controller(eng, ring, watchdog=wd, clock=clock)
+    for i in range(10):
+        ring.push(topic=0, payload=bytes([i]), publisher=i % 8)
+    wd.poll(); wd.poll()
+    assert wd.tier == 2
+    n_dec = len(ctl.decisions)
+    # The staged crash path hands both supervisors a FRESH pair.
+    eng2, ring2 = _engine(tiny_model, capacity=32)
+    eng2.warmup()
+    wd.reattach(eng2, ring2)
+    ctl.reattach(eng2, ring2)
+    # The fresh ring re-enters the tier's controls: shed set + policy.
+    assert ring2.policy == "drop_oldest"
+    assert not ring2.push(topic=1, payload=b"shed", publisher=1)
+    assert ring2.accounting()["shed_priority"] == 1
+    # The controller's memory (decisions, knob state) survives the swap.
+    assert len(ctl.decisions) == n_dec
+    assert ctl.engine is eng2 and ctl.ring is ring2
+
+
+def test_controller_gauges_and_controls_digest(tiny_model):
+    reg = MetricsRegistry(clock=FakeClock())
+    eng, ring = _engine(tiny_model, metrics=reg)
+    eng.warmup()
+    wd = Watchdog(eng, ring, chunk_stall_s=1e9, high_watermark=30,
+                  low_watermark=2, metrics=reg, clock=FakeClock())
+    ctl = Controller(eng, ring, watchdog=wd, metrics=reg,
+                     clock=FakeClock())
+    prom = reg.render_prometheus()
+    # The knob plane is visible from birth (satellite 1): controller
+    # gauges plus the watchdog tier as an explicit 0.
+    for fam in ("serve_controller_geometry_index",
+                "serve_controller_snapshot_every",
+                "serve_controller_desired_policy",
+                "serve_watchdog_tier"):
+        assert fam in prom, f"missing {fam} in /metrics"
+    doc = ctl.controls()
+    assert doc["knobs"] == ctl.knobs.to_dict()
+    assert doc["ladder"] == [list(g) for g in _LADDER]
+    assert doc["watchdog_tier"] == 0
+    assert doc["watchdog_tier_name"] == "normal"
+    json.dumps(doc)   # /debug/obs merges this verbatim: must be JSON-safe
+
+
+def test_knob_state_roundtrip():
+    ks = KnobState(geometry_index=1, backpressure_policy="reject",
+                   snapshot_every=4, flush_threshold=128,
+                   high_watermark=48, low_watermark=12)
+    assert KnobState(**ks.to_dict()) == ks
+
+
+# ---------------------------------------------------------------------------
+# spec / compiler lowering
+# ---------------------------------------------------------------------------
+
+
+def _drift_spec(streaming_overrides=None, slo_overrides=None):
+    streaming = {
+        "streaming_only": True,
+        "chunk_steps": 4,
+        "pub_width": 4,
+        "capacity": 64,
+        "policy": "block",
+        "controller": {"ladder": [[4, 4], [4, 8]]},
+        "compare_static": True,
+    }
+    streaming.update(streaming_overrides or {})
+    slo = dict(min_delivery_frac=0.9, max_queue_depth=64,
+               max_p99_vs_best_static_ratio=0.95,
+               min_controller_decisions=1,
+               max_unplanned_recompiles=0)
+    slo.update(slo_overrides or {})
+    return scenario.ScenarioSpec(
+        name="t_drift", family="multitopic", n_steps=16, seed=1,
+        model=dict(n_topics=2, n_peers=16, n_slots=8, conn_degree=4,
+                   msg_window=16, heartbeat_steps=4),
+        workloads=[scenario.Workload(kind="constant", topic=0, start=0,
+                                     stop=16, every=4)],
+        streaming=streaming,
+        slo=scenario.SLO(**slo),
+    )
+
+
+def test_compiler_lowers_controller_block():
+    plan = scenario.compile_streaming_plan(_drift_spec())
+    assert plan.controller is not None
+    assert plan.controller["ladder"] == [(4, 4), (4, 8)]
+    assert plan.compare_static is True
+
+
+def test_compare_static_requires_controller():
+    with pytest.raises(ValueError, match="compare_static"):
+        scenario.compile_streaming_plan(
+            _drift_spec(streaming_overrides={"controller": None}))
+
+
+def test_controller_ladder_must_contain_base_geometry():
+    with pytest.raises(ValueError, match="ladder"):
+        scenario.compile_streaming_plan(_drift_spec(
+            streaming_overrides={"controller": {"ladder": [[8, 2]]}}))
+
+
+def test_loss_regime_lowering_validates():
+    ok = scenario.compile_streaming_plan(_drift_spec(
+        streaming_overrides={
+            "loss_regimes": [{"start_step": 8, "stop_step": 12, "delay": 2}],
+        }))
+    assert ok.faults["loss_regimes"]
+    with pytest.raises(ValueError, match="delay"):
+        scenario.compile_streaming_plan(_drift_spec(
+            streaming_overrides={
+                "loss_regimes": [
+                    {"start_step": 8, "stop_step": 12, "delay": 0}
+                ],
+            }))
+
+
+def test_slo_roundtrips_controller_criteria():
+    spec = _drift_spec()
+    again = scenario.ScenarioSpec.from_json(spec.to_json())
+    assert again.slo.max_p99_vs_best_static_ratio == 0.95
+    assert again.slo.min_controller_decisions == 1
+    assert again.slo.max_unplanned_recompiles == 0
+
+
+def test_drifting_canon_registered_and_labeled():
+    spec = scenario.build_all(["streaming_drifting_load"])[0]
+    assert spec.streaming and "controller" in spec.streaming
+    assert spec.slo.max_p99_vs_best_static_ratio is not None
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "scenario_run.py"),
+         "--list"],
+        capture_output=True, text=True, check=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    ).stdout
+    line = [ln for ln in out.splitlines()
+            if ln.startswith("streaming_drifting_load")]
+    assert line and "ctl" in line[0].split()[1]
+
+
+@pytest.mark.slow
+def test_drifting_canon_green():
+    """The tentpole acceptance run: the self-tuned engine beats every
+    static rung on p99 with zero unplanned recompiles."""
+    spec = scenario.build_all(["streaming_drifting_load"])[0]
+    res = scenario.run_streaming_scenario(spec)
+    crit = {c.name: c for c in res.verdict.criteria}
+    assert res.verdict.passed, res.verdict.to_dict()
+    assert crit["p99_vs_best_static_ratio"].actual < 0.95
+    assert crit["unplanned_recompiles"].actual == 0
+    assert crit["controller_decisions"].actual >= 4
+
+
+# ---------------------------------------------------------------------------
+# perf_diff: pre-r20 records warn, never crash
+# ---------------------------------------------------------------------------
+
+
+def _bench_record(with_controller):
+    rec = {"metric": "steps_per_sec", "value": 1000.0}
+    if with_controller:
+        rec["controller"] = {
+            "scenario": "streaming_drifting_load",
+            "p99_vs_best_static_ratio": 0.5,
+            "tuned_p99_s": 0.02,
+            "best_static_p99_s": 0.04,
+            "knob_changes": 7,
+            "unplanned_recompiles": 0,
+            "ladder": [[4, 4], [4, 8], [24, 1]],
+        }
+    return rec
+
+
+def test_perf_diff_warns_on_pre_r20_record(tmp_path):
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    old.write_text(json.dumps(_bench_record(with_controller=False)))
+    new.write_text(json.dumps(_bench_record(with_controller=True)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "perf_diff.py"),
+         str(old), str(new)],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "controller" in out.stdout
+    assert "missing in old" in out.stdout

@@ -304,6 +304,96 @@ def test_watchdog_rejects_bad_config(model):
         Watchdog(eng, ring, topic_priority=[1, 2, 3])
 
 
+def test_crash_during_shed_tier_restores_and_reenters_tier(tmp_path):
+    """r20 satellite, the combined fault+overload case: the engine dies
+    WHILE the watchdog sits in shed_priority.  The replacement must come
+    back through the checkpoint AND re-enter the tier it died in (fresh
+    rings are born tierless — ``reattach`` re-applies the shed set and the
+    tier's policy), with the controller's KnobState riding across the
+    swap, every accepted message exactly-once (silent_drops == 0), the
+    recovery gap annotated on the spans that were in flight, and the
+    compile cache still exactly the ladder size."""
+    from go_libp2p_pubsub_tpu.obs.spans import SpanLedger
+    from go_libp2p_pubsub_tpu.serve import Controller
+
+    # Own model value (distinct msg_window): this test warms a 2-rung
+    # ladder, and the rollout cache is shared per model value — the other
+    # engines in this module assert cache size 1 on _CRASH_TINY's.
+    model = MultiTopicGossipSub(**dict(_CRASH_TINY, msg_window=28))
+    ladder = [(6, 2), (6, 4)]
+    clock = _FakeClock()
+    clock.t = 50.0
+    ledger = SpanLedger(clock=clock)
+    path = str(tmp_path / "engine.ckpt")
+
+    def build_pair():
+        ring = IngestRing(capacity=16, policy="block", clock=clock,
+                          tracer=ledger)
+        eng = StreamingEngine(model, ring, **_CHUNK, clock=clock,
+                              tracer=ledger, snapshot_path=path,
+                              snapshot_every=1, geometry_ladder=ladder)
+        eng.warmup()
+        return eng, ring
+
+    eng1, ring1 = build_pair()
+    wd = Watchdog(eng1, ring1, checkpoint_path=path, chunk_stall_s=1e9,
+                  high_watermark=6, low_watermark=2,
+                  topic_priority=[0, 1], clock=clock)
+    ctl = Controller(eng1, ring1, watchdog=wd, clock=clock)
+    for i in range(4):
+        ring1.push(topic=1, payload=b"pre %d" % i, publisher=i)
+    eng1.run_chunk()
+    # Overload: backlog past the high watermark escalates to tier 1, and
+    # pushing MORE than one chunk's slots leaves messages in the ring at
+    # the next auto-snapshot — accepted, un-popped, spans still open.
+    for i in range(14):
+        assert ring1.push(topic=1, payload=b"load %d" % i, publisher=i % 8)
+    assert wd.poll() == ["tier_up"] and wd.tier_name == "shed_priority"
+    assert not ring1.push(topic=0, payload=b"shed me", publisher=9)
+    assert ring1.accounting()["shed_priority"] == 1
+    eng1.run_chunk()      # pops 12; auto-snapshot holds 2 in the ring
+    assert ring1.depth == 2
+
+    # Crash: both halves of the pair are gone; the world stands still.
+    clock.t += 7.0
+    eng2, ring2 = build_pair()
+    wd.reattach(eng2, ring2)
+    ctl.reattach(eng2, ring2)
+    info = wd.restart_engine("chunk stall during shed_priority overload")
+    assert info["replayed"] == 2          # the un-popped ring items
+
+    # The tier survived the swap AND its controls bind on the FRESH ring
+    # (the restored ledger carries the pre-crash refusal: 1 -> 2).
+    assert wd.tier_name == "shed_priority"
+    assert not ring2.push(topic=0, payload=b"still shed", publisher=9)
+    assert ring2.accounting()["shed_priority"] == 2
+    assert wd.controller is ctl and ctl.ring is ring2
+    assert ctl.knobs.backpressure_policy == "block"
+
+    # The in-flight spans carry the measured gap with the tier context.
+    gaps = [e for sp in ledger.spans() for e in sp["events"]
+            if e["name"] == "crash_recovery"]
+    assert gaps, "no span annotated with the recovery gap"
+    for e in gaps:
+        assert e["gap_s"] >= 7.0
+        assert e["tier"] == "shed_priority"
+        assert "reason" in e
+
+    # Exactly-once drain on the restored pair; the ledger conserved.
+    eng2.run_until_drained(max_chunks=16)
+    assert eng2.completed == 18, "lost messages across crash in shed tier"
+    assert eng2.duplicate_completions == 0
+    assert ring2.accounting()["silent_drops"] == 0
+    assert ring1.accounting()["silent_drops"] == 0
+    assert eng2.compile_cache_size() == eng2.ladder_size() == 2
+
+    # Recovery over: draining under the low watermark de-escalates, and
+    # the fresh ring exits the tier into the controller's desired policy.
+    assert wd.poll() == ["tier_down"] and wd.tier_name == "normal"
+    assert ring2.policy == "block"
+    assert ring2.push(topic=0, payload=b"welcome back", publisher=1)
+
+
 # ---------------------------------------------------------------------------
 # streaming chaos: faults through the scenario runner
 # ---------------------------------------------------------------------------

@@ -418,7 +418,8 @@ def test_live_only_canon_flagged_and_filtered():
                       "streaming_engine_crash_recovery",
                       "streaming_verifier_crash",
                       "streaming_degraded_links",
-                      "streaming_rlnc_crash_recovery")
+                      "streaming_rlnc_crash_recovery",
+                      "streaming_drifting_load")
     for name in streaming_only:
         s = scenario.build(name)
         assert s.streaming_only

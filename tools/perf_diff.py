@@ -184,6 +184,20 @@ SCALAR_ROWS: List[Tuple[Tuple[str, ...], str, bool]] = [
      "coded serving lost after restart", False),
     (("hybrid", "coded_serving", "duplicate_deliveries"),
      "coded serving duplicate deliveries", False),
+    # Self-tuning controller section (r20+); warn-not-crash when a record
+    # predates it.  The headline is the self-tuned-vs-best-static p99 ratio
+    # on the drifting canon (< 1.0 = the closed loop beat every frozen
+    # configuration of its own ladder); knob changes count the decisions
+    # the loop took to get there (fewer for the same ratio = calmer
+    # control), and unplanned recompiles grade the pre-warm contract.
+    (("controller", "p99_vs_best_static_ratio"),
+     "controller p99 vs best-static ratio", False),
+    (("controller", "tuned_p99_s"), "controller tuned p99 (s)", False),
+    (("controller", "best_static_p99_s"),
+     "controller best static p99 (s)", False),
+    (("controller", "knob_changes"), "controller knob changes", False),
+    (("controller", "unplanned_recompiles"),
+     "controller unplanned recompiles", False),
     # Scenario-canon inventory section (r13+); same warn-not-crash behavior
     # as sharded/rlnc/streaming when a record lacks it.
     (("scenario_canon", "count"), "canon scenario count", True),
@@ -451,6 +465,30 @@ def context_warnings(old: Dict[str, Any], new: Dict[str, Any]) -> List[str]:
                 warns.append(
                     f"live_obs {key} differs: {lo.get(key)!r} vs "
                     f"{ln.get(key)!r}"
+                )
+    # Self-tuning controller section (r20+): a pre-r20 record never ran the
+    # drifting-canon A/B — warn, don't crash.
+    ko, kn = old.get("controller"), new.get("controller")
+    if (ko is None) != (kn is None):
+        which = "old" if ko is None else "new"
+        warns.append(
+            f"only one record has a 'controller' section (missing in "
+            f"{which}; added in r20) — self-tuned-vs-best-static rows are "
+            f"one-sided"
+        )
+    for name, s in (("old", ko), ("new", kn)):
+        if isinstance(s, dict) and "error" in s:
+            warns.append(
+                f"{name} controller section is an error record: "
+                f"{str(s['error'])[:200]}"
+            )
+    if (isinstance(ko, dict) and isinstance(kn, dict)
+            and "error" not in ko and "error" not in kn):
+        for key in ("scenario", "ladder"):
+            if ko.get(key) != kn.get(key):
+                warns.append(
+                    f"controller {key} differs: {ko.get(key)!r} vs "
+                    f"{kn.get(key)!r}"
                 )
     # Adaptive coded gossip section (r16+): same treatment.
     ho, hn = old.get("hybrid"), new.get("hybrid")

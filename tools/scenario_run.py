@@ -123,6 +123,11 @@ def main(argv: List[str] | None = None) -> int:
             if args.plane and not supported[args.plane](s):
                 continue
             planes = [p for p, ok_fn in supported.items() if ok_fn(s)]
+            # Self-tuning canons (r20) carry a controller block: the run
+            # closes the telemetry→knob loop and grades the self-tuned
+            # engine against its own static rungs.
+            if s.streaming and "controller" in s.streaming:
+                planes.append("ctl")
             print(f"{name:<26} {'+'.join(planes):<10} {s.description}")
             shown += 1
         if shown == 0:

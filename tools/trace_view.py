@@ -49,6 +49,28 @@ def _fmt_s(v: Any) -> str:
         return str(v)
 
 
+def _decision_rows(doc: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Chronological ``controller_decision`` ledger events (r20): the
+    self-tuning controller stamps one per knob change, carrying the
+    triggering evidence as ``ev_*`` keys — this is the audit trail that
+    makes a verdict flip attributable to a measurement."""
+    rows = []
+    for ev in doc.get("events", []):
+        if ev.get("name") != "controller_decision":
+            continue
+        rows.append({
+            "t": ev.get("t"),
+            "knob": ev.get("knob"),
+            "old": ev.get("old"),
+            "new": ev.get("new"),
+            "reason": ev.get("reason"),
+            "evidence": {k[3:]: v for k, v in ev.items()
+                         if k.startswith("ev_")},
+        })
+    rows.sort(key=lambda r: (r["t"] is None, r["t"]))
+    return rows
+
+
 def _span_summary(doc: Dict[str, Any]) -> Dict[str, Any]:
     s = doc.get("summary", {})
     gaps: List[float] = []
@@ -71,10 +93,12 @@ def _span_summary(doc: Dict[str, Any]) -> Dict[str, Any]:
         "events": s.get("events", {}),
         "spans_with_recovery_gap": len(gaps),
         "max_recovery_gap_s": max(gaps) if gaps else None,
+        "controller_decisions": _decision_rows(doc),
         "chrome_events": len(
             doc.get("chrome_trace", {}).get("traceEvents", [])),
     }
-    for key in ("recovery_s", "recovery_gap_s", "chunk_wall_s", "latency"):
+    for key in ("recovery_s", "recovery_gap_s", "chunk_wall_s", "latency",
+                "controller"):
         if key in doc:
             out[key] = doc[key]
     return out
@@ -105,6 +129,28 @@ def _print_span(out: Dict[str, Any]) -> None:
                 qs = "  ".join(f"{k}={_fmt_s(v)}" for k, v in sorted(
                     q.items()))
                 print(f"  latency[{mode}]: {qs}")
+    decisions = out.get("controller_decisions") or []
+    if decisions:
+        print(f"  controller decisions: {len(decisions)}")
+        for d in decisions:
+            # Show the two or three evidence values a reader needs to
+            # check the decision against its policy threshold, not the
+            # whole evidence dict.
+            ev = d["evidence"]
+            keys = [k for k in ("depth", "carry",
+                                "avg_snapshot_s", "chunk_wall_s",
+                                "verify_batch", "block_waits")
+                    if k in ev][:3]
+            ev_s = " ".join(
+                f"{k}={ev[k]:.4g}" if isinstance(ev[k], float)
+                else f"{k}={ev[k]}" for k in keys)
+            print(f"    t={_fmt_s(d['t'])} {d['knob']}: "
+                  f"{d['old']} -> {d['new']}  [{d['reason']}]  {ev_s}")
+    ctl = out.get("controller")
+    if isinstance(ctl, dict):
+        print(f"  controller A/B: tuned p99 {_fmt_s(ctl.get('tuned_p99_s'))}"
+              f" vs best static {_fmt_s(ctl.get('best_static_p99_s'))} "
+              f"(ratio {ctl.get('p99_vs_best_static_ratio')})")
     print(f"  chrome_trace: {out['chrome_events']} events "
           f"(load the artifact in chrome://tracing)")
 
