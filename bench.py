@@ -2229,6 +2229,47 @@ def child_main() -> None:
         scenario_canon = {"error": f"{type(e).__name__}: {e}"}
         log(f"scenario smoke FAILED to run: {scenario_verdict['error']}")
 
+    # Co-evolution inventory rider (r21+): the committed audit artifact's
+    # headline numbers — reds the adversarial loop found, candidates its
+    # invariant gate rejected, and the digest of the promoted default —
+    # so a cross-round diff notices the hardened config changing or the
+    # archive shrinking.  Reads the artifact only; the loop itself runs
+    # offline via tools/coevolve.py.
+    try:
+        from go_libp2p_pubsub_tpu.scenario.defense import (
+            PROMOTED_DEFENSE, defense_digest,
+        )
+
+        audit_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "tests", "golden", "coevolve_audit.json",
+        )
+        with open(audit_path) as fh:
+            audit = json.load(fh)
+        promo = audit.get("promotion", {})
+        coevolve_inv = {
+            "reds_found": audit["reds_found"],
+            "invariant_rejections": audit["invariant_rejections"],
+            "iterations": len(audit.get("iterations", [])),
+            "archived_reds": len(audit.get("red_artifacts", [])),
+            "promoted": bool(promo.get("promoted")),
+            "promoted_digest": audit.get("promoted_digest"),
+            "loaded_digest": defense_digest(PROMOTED_DEFENSE),
+            "margin": {
+                axis: promo["standing"][axis] - promo["final"][axis]
+                for axis in ("canon_reds", "fresh_reds", "archive_reds")
+                if "standing" in promo and "final" in promo
+            },
+        }
+        log(
+            f"coevolve audit: {coevolve_inv['reds_found']} reds, "
+            f"{coevolve_inv['invariant_rejections']} gate rejections, "
+            f"promoted {coevolve_inv['promoted_digest']}"
+        )
+    except Exception as e:  # pragma: no cover - diagnostic surface
+        coevolve_inv = {"error": f"{type(e).__name__}: {e}"}
+        log(f"coevolve inventory unavailable: {coevolve_inv['error']}")
+
     trace_out = os.environ.get("BENCH_TRACE_OUT")
     if trace_out:
         with open(trace_out, "w") as fh:
@@ -2268,6 +2309,7 @@ def child_main() -> None:
                 "flight": flight,
                 "scenario_smoke": scenario_verdict,
                 "scenario_canon": scenario_canon,
+                "coevolve": coevolve_inv,
                 "ed25519_device_scaling": device_curve,
                 "ed25519_batch_knee": device_batch_knee,
                 "ed25519_layout_ab": device_layout_ab,

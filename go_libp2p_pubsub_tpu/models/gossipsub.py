@@ -424,15 +424,21 @@ class GossipSub:
     # compiled rollouts (``self`` is a static argnum everywhere).  Without
     # this, every ``compile_scenario``/test constructing a fresh model
     # recompiles the full scan body.  Instances carrying non-value extras
-    # (a custom topology builder, a shard mesh) fall back to identity.
+    # (a custom topology builder, a shard mesh) fall back to identity —
+    # unless the builder declares its own value identity via a hashable
+    # ``config_key`` attribute (scenario/realism.py's declarative
+    # builders do), in which case two models wired to equally-configured
+    # builders still share compiled rollouts.
     def _config_key(self):
+        builder_key = getattr(self.builder, "config_key", None)
         if (
-            self.builder is not None
+            (self.builder is not None and builder_key is None)
             or self.pallas_shard_mesh is not None
             or self.split_gather_mesh is not None
         ):
             return id(self)
         return (
+            builder_key,
             type(self), self.n, self.k, self.m, self.conn_degree,
             self.params, self.score_params, self.heartbeat_steps,
             self.use_pallas, self.max_edge_delay, self.fused_prologue,

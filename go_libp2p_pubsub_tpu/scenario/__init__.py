@@ -11,6 +11,20 @@
 """
 
 from .canon import CANON, build, build_all
+from .defense import (
+    HARDENED_DEFENSE,
+    PROMOTED_DEFENSE,
+    STANDING_DEFENSE,
+    check_invariants,
+    defense_digest,
+)
+from .realism import (
+    apply_realism,
+    diurnal_churn,
+    geo_latency_links,
+    heavy_tailed_builder,
+    topology_builder,
+)
 from .compiler import (
     CompiledScenario,
     StreamingPlan,
@@ -54,10 +68,13 @@ __all__ = [
     "ChurnPhase",
     "CompiledScenario",
     "Criterion",
+    "HARDENED_DEFENSE",
     "LinkWindow",
     "LivePlaneError",
     "LiveScenarioResult",
+    "PROMOTED_DEFENSE",
     "SLO",
+    "STANDING_DEFENSE",
     "ScenarioResult",
     "ScenarioSpec",
     "StreamingPlan",
@@ -65,11 +82,17 @@ __all__ = [
     "StreamingScenarioResult",
     "Verdict",
     "Workload",
+    "apply_realism",
     "build",
     "build_all",
+    "check_invariants",
     "compile_scenario",
     "compile_streaming_plan",
+    "defense_digest",
+    "diurnal_churn",
     "evaluate",
+    "geo_latency_links",
+    "heavy_tailed_builder",
     "live_supported",
     "replay_trace",
     "run_live_scenario",
@@ -79,5 +102,6 @@ __all__ = [
     "save_trace",
     "sim_supported",
     "streaming_supported",
+    "topology_builder",
     "trace_document",
 ]

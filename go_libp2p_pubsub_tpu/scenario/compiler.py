@@ -80,7 +80,17 @@ def build_model(spec: ScenarioSpec, graft_spammers=None):
         from ..models.gossipsub import GossipSub
 
         kw = _split_model_kwargs(spec)
+        # Declarative topology (r21 realism): a {"kind": ...} dict lowered
+        # to a builder closure carrying a value-semantic config_key, so
+        # equally-textured models still share jit-compiled rollouts.
+        topo = kw.pop("topology", None)
+        if topo is not None:
+            from .realism import topology_builder
+
+            kw["builder"] = topology_builder(topo)
         return GossipSub(use_pallas=False, graft_spammers=graft_spammers, **kw)
+    if "topology" in spec.model:
+        raise ValueError("model topology dicts are gossipsub-only")
     if spec.family == "multitopic":
         from ..models.multitopic import MultiTopicGossipSub
 
@@ -764,7 +774,9 @@ def compile_streaming_plan(spec: ScenarioSpec) -> StreamingPlan:
             "are the ladder's rungs — nothing to compare without a ladder)"
         )
     compare_eager = bool(cfg.get("compare_eager", False))
-    if (compare_eager or "loss" in faults) and spec.family != "hybrid":
+    if (
+        compare_eager or "loss" in faults or "loss_oscillate" in faults
+    ) and spec.family != "hybrid":
         raise ValueError(
             "loss windows / compare_eager are hybrid-family features "
             "(only the hybrid model stamps per-chunk ingress loss)"
@@ -926,6 +938,39 @@ def _lower_streaming_faults(
         faults["loss"] = {
             "start_chunk": start, "stop_chunk": stop, "delay": delay,
         }
+    if cfg.get("loss_oscillate") is not None:
+        # r21 hysteresis-oscillation attack (hybrid plane): the adversary
+        # flips the link between lossy (decimation ``delay``) and clean
+        # every ``period_chunks`` chunks inside [start_chunk, stop_chunk),
+        # starting lossy.  Tuned to straddle the hybrid's switch_hi /
+        # switch_lo band, it tries to force worst-of-both behavior out of
+        # the eager<->coded estimator (each flip lands just as the EWMA
+        # crosses a threshold).
+        ow = dict(cfg["loss_oscillate"])
+        start = int(ow.get("start_chunk", 0))
+        stop = int(ow.get("stop_chunk", n_chunks))
+        period = int(ow.get("period_chunks", 1))
+        delay = int(ow.get("delay", 1))
+        if delay < 1:
+            raise ValueError(
+                "loss_oscillate.delay must be >= 1 (decimation period)"
+            )
+        if period < 1:
+            raise ValueError("loss_oscillate.period_chunks must be >= 1")
+        if not (0 <= start < stop <= n_chunks):
+            raise ValueError(
+                f"loss_oscillate window [{start}, {stop}) outside the "
+                f"campaign's chunk range [0, {n_chunks}]"
+            )
+        if "loss" in faults:
+            raise ValueError(
+                "\"loss\" and \"loss_oscillate\" stamp the same ingress-"
+                "delay lever — use one or the other"
+            )
+        faults["loss_oscillate"] = {
+            "start_chunk": start, "stop_chunk": stop,
+            "period_chunks": period, "delay": delay,
+        }
     if cfg.get("loss_regimes") is not None:
         # r20 drifting-workload windows: STEP-keyed (not chunk-keyed) so
         # the same spec is fair across chunk geometries — a controller
@@ -955,10 +1000,11 @@ def _lower_streaming_faults(
             regimes.append(
                 {"start_step": start, "stop_step": stop, "delay": delay}
             )
-        if "loss" in faults:
+        if "loss" in faults or "loss_oscillate" in faults:
             raise ValueError(
-                "\"loss\" (chunk-keyed) and \"loss_regimes\" (step-keyed) "
-                "stamp the same ingress-delay lever — use one or the other"
+                "\"loss\"/\"loss_oscillate\" (chunk-keyed) and "
+                "\"loss_regimes\" (step-keyed) stamp the same ingress-"
+                "delay lever — use one or the other"
             )
         faults["loss_regimes"] = regimes
     return faults

@@ -384,6 +384,12 @@ class ScenarioSpec:
     streaming: Optional[Dict[str, Any]] = None
     slo: SLO = field(default_factory=SLO)
     description: str = ""
+    # Provenance stamp for archived replay artifacts (r21 co-evolution):
+    # {"defense_digest": str, "found_by": str, "search_seed": int, ...}.
+    # Never read by the compiler — a plain optional dict (like ``live`` /
+    # ``streaming``) so the exact JSON round-trip holds and specs that
+    # predate the field still load.
+    meta: Optional[Dict[str, Any]] = None
 
     def __post_init__(self) -> None:
         if self.family not in FAMILIES:

@@ -202,8 +202,20 @@ def run_streaming_scenario(
     # staged crash (which rewinds the engine's chunk count) cannot shift
     # the window, and the post-window / drain chunks run on clean fabric.
     loss_w = faults.get("loss")
+    # Hysteresis-oscillation window (r21): same monotone-counter stamping
+    # discipline, but the delay flips lossy/clean every period_chunks
+    # inside the window (starting lossy) — the adversary straddling the
+    # hybrid's switch_hi/switch_lo band.
+    osc_w = faults.get("loss_oscillate")
 
     def _stamp_loss(eng, ci: int) -> None:
+        if osc_w is not None:
+            inside = osc_w["start_chunk"] <= ci < osc_w["stop_chunk"]
+            lossy = inside and (
+                (ci - osc_w["start_chunk"]) // osc_w["period_chunks"]
+            ) % 2 == 0
+            eng.set_ingress_delay(osc_w["delay"] if lossy else 0)
+            return
         if loss_w is None:
             return
         inside = loss_w["start_chunk"] <= ci < loss_w["stop_chunk"]

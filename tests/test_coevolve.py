@@ -1,0 +1,238 @@
+"""Adversarial co-evolution loop (r21): smoke, gate, and artifact pins.
+
+Three layers:
+
+1. a fast deterministic 2-iteration loop smoke (tier 1): the alternating
+   attack/defense loop runs end to end with toy budgets, rejects the
+   invariant-violating probe it always proposes, archives at least one
+   red, and two same-seed runs emit byte-identical audit documents;
+2. committed-artifact shape: the shipped audit + promoted-config
+   artifacts agree with each other and with the loaded
+   ``scenario.PROMOTED_DEFENSE``;
+3. regression pins: the archived reds stay RED under the pre-PR standing
+   config and GREEN under the promoted config — the co-evolution loop's
+   findings, frozen as replayable fixtures alongside
+   ``fuzz_red_cold_boot.json``.
+"""
+
+import importlib
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(REPO, "tests", "golden")
+
+coevolve = importlib.import_module("tools.coevolve")
+fuzz = importlib.import_module("tools.scenario_fuzz")
+
+
+def _run_loop(tmp_path, tag):
+    audit_path = str(tmp_path / f"audit_{tag}.json")
+    rc = coevolve.main([
+        "--budget", "2", "--seed", "0",
+        "--attack-budget", "1", "--defense-probes", "2",
+        "--fresh-budget", "1",
+        "--shallow-gate", "--no-shrink", "--no-realism",
+        "--quick-gate", "--gate-battery", "1",
+        "--no-quick-battery", "--dry-run",
+        "--archive-dir", str(tmp_path / "golden"),
+        "--audit", audit_path,
+        "--json",
+    ])
+    assert rc == 0
+    with open(audit_path) as f:
+        return f.read()
+
+
+def test_coevolve_two_iteration_smoke_deterministic(tmp_path, capsys):
+    doc1 = _run_loop(tmp_path, "a")
+    doc2 = _run_loop(tmp_path, "b")
+    capsys.readouterr()  # swallow the --json dumps
+    # Same seed, same budgets -> byte-identical audit (no wall clock, no
+    # unseeded randomness anywhere in the loop).
+    assert doc1 == doc2, "same-seed co-evolution runs diverged"
+
+    audit = json.loads(doc1)
+    assert audit["seed"] == 0 and audit["budget"] == 2
+    assert len(audit["iterations"]) == 2
+
+    # The loop's adversarial self-check: the P4 sign-flip probe is
+    # proposed every iteration and the invariant gate must reject it —
+    # a run that rejects nothing has a broken gate.
+    assert audit["invariant_rejections"] >= 2
+    rejects = [
+        c for it in audit["iterations"] for c in it["candidates"]
+        if c["gate"] == "reject"
+    ]
+    assert rejects and all(c["violations"] for c in rejects)
+    assert any(
+        "p4_monotonicity" in v for c in rejects for v in c["violations"]
+    )
+    # Only gate-passing candidates were ever graded.
+    for it in audit["iterations"]:
+        for c in it["candidates"]:
+            assert ("objective" in c) == (c["gate"] == "pass")
+
+    # Seed 0's first fuzz sample is the cold-boot monopoly red: the loop
+    # must find it, archive it, and stamp its provenance.
+    assert audit["reds_found"] >= 1
+    assert audit["red_artifacts"]
+    from go_libp2p_pubsub_tpu.scenario.spec import ScenarioSpec
+
+    with open(audit["red_artifacts"][0]) as f:
+        red = ScenarioSpec.from_json(f.read())
+    assert red.meta["found_by"] == "coevolve"
+    assert red.meta["defense_digest"] == audit["standing_digest"]
+
+    # The promotion section compares final vs standing on all three axes.
+    promo = audit["promotion"]
+    for side in ("standing", "final"):
+        for axis in ("canon_reds", "fresh_reds", "archive_reds"):
+            assert isinstance(promo[side][axis], int)
+
+
+def test_committed_audit_and_promoted_config_agree():
+    """The shipped artifacts are a consistent set: the audit's promoted
+    digest is the promoted-config file's digest is the digest of the
+    defense the package actually loads as ``PROMOTED_DEFENSE``."""
+    from go_libp2p_pubsub_tpu import scenario
+    from go_libp2p_pubsub_tpu.scenario.defense import (
+        PROMOTED_PATH, defense_digest,
+    )
+
+    with open(os.path.join(GOLDEN, "coevolve_audit.json")) as f:
+        audit = json.load(f)
+    assert audit["promotion"]["promoted"] is True
+    assert audit["reds_found"] >= 2
+    assert audit["invariant_rejections"] >= 1
+    with open(PROMOTED_PATH) as f:
+        doc = json.load(f)
+    assert doc["digest"] == audit["promoted_digest"]
+    assert defense_digest(doc["defense"]) == doc["digest"]
+    assert defense_digest(scenario.PROMOTED_DEFENSE) == doc["digest"]
+    # The promoted config passes its own invariant gate (shallow: the
+    # deep rollout half runs in the slow pin below and in the loop).
+    ok, violations = scenario.check_invariants(scenario.PROMOTED_DEFENSE)
+    assert ok, violations
+    # And the audit's margin table says it dominated standing.
+    promo = audit["promotion"]
+    axes = ("canon_reds", "fresh_reds", "archive_reds")
+    assert all(
+        promo["final"][a] <= promo["standing"][a] for a in axes
+    )
+    assert any(
+        promo["final"][a] < promo["standing"][a] for a in axes
+    )
+
+
+# The regression pins (>= 2 new reds beyond fuzz_red_cold_boot.json):
+# replay artifacts the r21 co-evolution run discovered and minimized
+# that the promoted config actually fixes.  Each must stay RED under
+# the pre-PR standing config and turn GREEN under the promoted config —
+# the committed proof the promotion gate's margin is real.  The OTHER
+# archived coevolve_red_* artifacts are reds the promoted config does
+# NOT fix (the audit's final gate says 8 of 11 stay red) — they stay in
+# the archive as open findings for the next hunt, not as pins.
+_PINNED_REDS = (
+    "coevolve_red_s0_i0008.json",
+    "coevolve_red_s0_i0009.json",
+    "coevolve_red_s0_i0012.json",
+)
+
+
+def test_at_least_two_reds_pinned():
+    assert len(_PINNED_REDS) >= 2
+    for name in _PINNED_REDS:
+        assert os.path.exists(os.path.join(GOLDEN, name)), name
+
+
+# ---------------------------------------------------------------------------
+# perf_diff: pre-r21 records warn, never crash
+# ---------------------------------------------------------------------------
+
+
+def _bench_record(with_coevolve, promoted="abc123def456", loaded=None):
+    rec = {"metric": "steps_per_sec", "value": 1000.0}
+    if with_coevolve:
+        rec["coevolve"] = {
+            "reds_found": 11,
+            "invariant_rejections": 2,
+            "iterations": 2,
+            "archived_reds": 11,
+            "promoted": True,
+            "promoted_digest": promoted,
+            "loaded_digest": loaded if loaded is not None else promoted,
+        }
+    return rec
+
+
+def _run_perf_diff(tmp_path, old_rec, new_rec):
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    old.write_text(json.dumps(old_rec))
+    new.write_text(json.dumps(new_rec))
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "perf_diff.py"),
+         str(old), str(new)],
+        capture_output=True, text=True,
+    )
+
+
+def test_perf_diff_warns_on_pre_r21_record(tmp_path):
+    out = _run_perf_diff(
+        tmp_path, _bench_record(False), _bench_record(True)
+    )
+    assert out.returncode == 0, out.stderr
+    assert "coevolve" in out.stdout
+    assert "missing in old" in out.stdout
+
+
+def test_perf_diff_flags_promoted_digest_change(tmp_path):
+    out = _run_perf_diff(
+        tmp_path,
+        _bench_record(True, promoted="aaaaaaaaaaaa"),
+        _bench_record(True, promoted="bbbbbbbbbbbb"),
+    )
+    assert out.returncode == 0, out.stderr
+    assert "promoted defense changed" in out.stdout
+    # And a record whose loaded config drifted from its audit warns too.
+    out = _run_perf_diff(
+        tmp_path,
+        _bench_record(True),
+        _bench_record(True, loaded="cccccccccccc"),
+    )
+    assert out.returncode == 0, out.stderr
+    assert "out of sync" in out.stdout
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", _PINNED_REDS)
+def test_pinned_red_flips_with_defense(name):
+    from go_libp2p_pubsub_tpu.scenario.defense import (
+        STANDING_DEFENSE, defense_digest,
+    )
+    from go_libp2p_pubsub_tpu.scenario.spec import ScenarioSpec
+
+    with open(os.path.join(GOLDEN, name)) as f:
+        spec = ScenarioSpec.from_json(f.read())
+    # Provenance: every archived red names the config it was red against.
+    assert spec.meta and spec.meta["defense_digest"]
+    assert spec.meta["found_by"] == "coevolve"
+    # Red under the pre-PR standing defense...
+    assert coevolve.red_under(spec, STANDING_DEFENSE), (
+        f"{name} no longer red under standing "
+        f"({defense_digest(STANDING_DEFENSE)})"
+    )
+    # ...green under the promoted config.
+    from go_libp2p_pubsub_tpu import scenario
+
+    status, _, failed = fuzz._grade(
+        coevolve._with_defense(spec, scenario.PROMOTED_DEFENSE)
+    )
+    assert status == "green", (
+        f"{name} still {status} under the promoted config: {failed}"
+    )

@@ -614,7 +614,8 @@ def test_fuzz_cli_streaming_plane_end_to_end():
         assert e["status"] in ("red", "green", "invalid")
         assert e["kind"] in ("engine_crash", "verifier_crash",
                              "producer_stall", "clock_skew", "no_fault",
-                             "degraded_links", "crash_mid_generation")
+                             "degraded_links", "oscillating_loss",
+                             "crash_mid_generation")
 
 
 # ---------------------------------------------------------------------------

@@ -202,6 +202,15 @@ SCALAR_ROWS: List[Tuple[Tuple[str, ...], str, bool]] = [
     # as sharded/rlnc/streaming when a record lacks it.
     (("scenario_canon", "count"), "canon scenario count", True),
     (("scenario_canon", "attack_count"), "canon attack campaigns", True),
+    # Co-evolution inventory section (r21+): reds the adversarial loop
+    # discovered + archived, invariant-gate rejections (a loop that stops
+    # rejecting anything has a broken gate), and the archive size.  The
+    # promoted-config digest is compared in context_warnings, not here —
+    # a digest is not a scalar.  Pre-r21 records show "-" plus a warning.
+    (("coevolve", "reds_found"), "coevolve reds found", True),
+    (("coevolve", "invariant_rejections"),
+     "coevolve gate rejections", True),
+    (("coevolve", "archived_reds"), "coevolve archived reds", True),
     # Hardware-shape restructure rows (r15+): ed25519 batch knee (smallest
     # batch at >=90% of peak — lower means the lanes fill earlier), the
     # row-major vs batch-major layout A/B, the GF(256) table-vs-MXU
@@ -590,6 +599,39 @@ def context_warnings(old: Dict[str, Any], new: Dict[str, Any]) -> List[str]:
                     f"canon smoke verdict {vname} flipped red between "
                     f"rounds"
                 )
+    # Co-evolution inventory section (r21+): warn-not-crash on pre-r21
+    # records, surface error records, and say so loudly when the shipped
+    # default defense changed between rounds or the loaded config drifts
+    # from the audited promotion.
+    vo, vn = old.get("coevolve"), new.get("coevolve")
+    if (vo is None) != (vn is None):
+        which = "old" if vo is None else "new"
+        warns.append(
+            f"only one record has a 'coevolve' section (missing in "
+            f"{which}; added in r21) — coevolve rows are one-sided"
+        )
+    for name, s in (("old", vo), ("new", vn)):
+        if isinstance(s, dict) and "error" in s:
+            warns.append(
+                f"{name} coevolve section is an error record: "
+                f"{str(s['error'])[:200]}"
+            )
+        elif isinstance(s, dict) and s.get("promoted_digest") and (
+                s.get("loaded_digest") != s.get("promoted_digest")):
+            warns.append(
+                f"{name} record loaded defense {s.get('loaded_digest')} "
+                f"but its audit promoted {s.get('promoted_digest')} — "
+                f"promoted_defense.json and the audit are out of sync"
+            )
+    if (isinstance(vo, dict) and isinstance(vn, dict)
+            and "error" not in vo and "error" not in vn):
+        if (vo.get("promoted_digest") and vn.get("promoted_digest")
+                and vo["promoted_digest"] != vn["promoted_digest"]):
+            warns.append(
+                f"promoted defense changed between rounds: "
+                f"{vo['promoted_digest']} -> {vn['promoted_digest']} "
+                f"(re-check the audit's margin table)"
+            )
     return warns
 
 

@@ -82,6 +82,9 @@ sys.path.insert(
 import numpy as np  # noqa: E402
 
 from go_libp2p_pubsub_tpu import scenario  # noqa: E402
+from go_libp2p_pubsub_tpu.scenario.defense import (  # noqa: E402
+    HARDENED_DEFENSE, PROMOTED_DEFENSE, STANDING_DEFENSE, defense_digest,
+)
 from go_libp2p_pubsub_tpu.scenario.spec import (  # noqa: E402
     SLO, AttackWave, ChurnPhase, LinkWindow, ScenarioSpec, Workload,
 )
@@ -94,26 +97,15 @@ _TAG_FUZZ = 5
 # run both hunts without aliased draws.
 _TAG_DEFENSE = 6
 
-# The standing defense: the scored config the canon shipped BEFORE the
-# taxonomy PR — P4 hammer + P6 colocation, P3 at its shipped default
-# (disabled; upstream guidance is that its threshold must be rate-tuned).
-STANDING_DEFENSE = {
-    "invalid_message_deliveries_weight": -30.0,
-    "ip_colocation_factor_weight": -1.0,
-    "ip_colocation_factor_threshold": 1.0,
+# The named defense registry lives in scenario/defense.py (r21): the
+# standing (pre-taxonomy) config, the hand-hardened cold-boot fix, and
+# whatever the last co-evolution run promoted (falls back to hardened
+# when no promotion artifact is committed).
+DEFENSES = {
+    "standing": STANDING_DEFENSE,
+    "hardened": HARDENED_DEFENSE,
+    "promoted": PROMOTED_DEFENSE,
 }
-
-# The hardened config: the fix for the cold-boot monopoly the first hunt
-# found.  P3 enabled with a threshold tuned to the fuzz mesh's observed
-# steady delivery rate (~2 msgs / decay interval on the every-2 workload).
-HARDENED_DEFENSE = dict(
-    STANDING_DEFENSE,
-    mesh_message_deliveries_weight=-1.0,
-    mesh_message_deliveries_threshold=1.5,
-    mesh_message_deliveries_activation_s=3.0,
-)
-
-DEFENSES = {"standing": STANDING_DEFENSE, "hardened": HARDENED_DEFENSE}
 
 # One fixed mesh for the whole search: every sample shares the model
 # shapes, so the rollout jit cache carries across the budget.
@@ -318,11 +310,23 @@ def sample_streaming_spec(
         # Always degraded: the last traffic chunk stays clean so the drain
         # finishes whatever the estimator's switch latency left pending.
         lo_start = int(rng.integers(0, 2))
-        streaming["loss"] = {
-            "start_chunk": lo_start,
-            "stop_chunk": int(rng.integers(lo_start + 1, n_chunks)),
-            "delay": int(rng.choice([1, 2, 3])),
-        }
+        if rng.random() < 0.35:
+            # Hysteresis-oscillation attack (r21): the adversary flips the
+            # link lossy/clean every period_chunks across the whole window,
+            # straddling the switch_hi/switch_lo band to force worst-of-
+            # both behavior out of the eager<->coded estimator.
+            streaming["loss_oscillate"] = {
+                "start_chunk": lo_start,
+                "stop_chunk": int(rng.integers(lo_start + 2, n_chunks + 1)),
+                "period_chunks": int(rng.choice([1, 2])),
+                "delay": int(rng.choice([1, 2, 3])),
+            }
+        else:
+            streaming["loss"] = {
+                "start_chunk": lo_start,
+                "stop_chunk": int(rng.integers(lo_start + 1, n_chunks)),
+                "delay": int(rng.choice([1, 2, 3])),
+            }
     if policy == "block":
         # No blocking stalls in a single-threaded hunt: one flush's worth
         # of pushes (a group, doubled by the verifier retry window, plus
@@ -403,6 +407,21 @@ SAMPLERS = {
 # cover the three standing-failure axes the taxonomy PR measured: score
 # starvation from boot, reputation built then spent, and raw spam volume.
 DEFENSE_BATTERY = ("cold_boot_eclipse", "covert_flash", "spam_flood")
+
+
+def full_battery():
+    """EVERY sim-plane canon attack campaign — the promotion gate (r21).
+
+    The quick 3-campaign battery is a search heuristic; a config headed
+    for the shipped default must survive the whole canon.  Computed from
+    the canon registry, so newly added attack scenarios join the gate
+    automatically.
+    """
+    return tuple(
+        name for name, builder in scenario.CANON.items()
+        if (lambda s: s.attacks and not s.live_only
+            and not s.streaming_only)(builder())
+    )
 
 
 def sample_defense(seed: int, index: int) -> dict:
@@ -509,7 +528,8 @@ def _mutations(spec: ScenarioSpec, plane: str = "sim") -> List[ScenarioSpec]:
         # thin the workload — the minimal red names the one fault + load
         # shape that actually breaks the config.
         cfg = dict(spec.streaming or {})
-        for key in ("clock_skew", "producer_stall", "loss", "compare_eager",
+        for key in ("clock_skew", "producer_stall", "loss", "loss_oscillate",
+                    "compare_eager",
                     "verifier_crash_at_chunk", "crash_at_chunk"):
             if key in cfg:
                 smaller = {
@@ -624,9 +644,12 @@ def _spec_kind(spec: ScenarioSpec, plane: str) -> str:
             ("producer_stall", "producer_stall"),
             ("clock_skew", "clock_skew"),
             ("loss", "degraded_links"),
+            ("loss_oscillate", "oscillating_loss"),
         ):
             if key in cfg:
-                if key == "crash_at_chunk" and "loss" in cfg:
+                if key == "crash_at_chunk" and (
+                    "loss" in cfg or "loss_oscillate" in cfg
+                ):
                     return "crash_mid_generation"
                 return label
         return "no_fault"
@@ -655,6 +678,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--defense", choices=sorted(DEFENSES), default="standing",
                     help="standing score config to fuzz against "
                     "(attack search, sim plane)")
+    ap.add_argument("--battery", choices=("quick", "full"), default="quick",
+                    help="defense-search battery: quick (3 campaigns, the "
+                    "search heuristic) or full (every canon attack — the "
+                    "promotion gate)")
     ap.add_argument("--shrink", action="store_true",
                     help="minimize the first red config found "
                     "(attack search)")
@@ -671,14 +698,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                  "exists on the sim plane")
 
     if args.search == "defense":
+        battery = (
+            DEFENSE_BATTERY if args.battery == "quick" else full_battery()
+        )
         trajectory = []
         first_fragile = None
         for i in range(args.budget):
             defense = sample_defense(args.seed, i)
-            worst, results = grade_defense(defense)
+            worst, results = grade_defense(defense, battery=battery)
             entry = {
                 "index": i,
-                "digest": _digest_obj(defense),
+                "digest": defense_digest(defense),
                 "status": worst,
                 "defense": defense,
                 "campaigns": [
@@ -700,6 +730,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             "seed": args.seed,
             "budget": args.budget,
             "search": "defense",
+            "battery": args.battery,
             "red": n_red,
             "green": args.budget - n_red - n_inv,
             "invalid": n_inv,
@@ -718,6 +749,9 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     sampler = SAMPLERS[args.plane]
     defense = DEFENSES[args.defense] if args.plane == "sim" else None
+    # Every red report names the exact config it was red AGAINST (r21
+    # satellite): an archived red is meaningless without its defense.
+    ddig = None if defense is None else defense_digest(defense)
     trajectory = []
     first_red: Optional[ScenarioSpec] = None
     for i in range(args.budget):
@@ -730,6 +764,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             "status": status,
             "failed": failed,
         }
+        if ddig is not None:
+            entry["defense_digest"] = ddig
         trajectory.append(entry)
         if not args.json:
             extra = f"  [{', '.join(failed)}]" if failed else ""
@@ -745,6 +781,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         "budget": args.budget,
         "plane": args.plane,
         "defense": args.defense if args.plane == "sim" else None,
+        "defense_digest": ddig,
         "red": n_red,
         "green": args.budget - n_red - n_inv,
         "invalid": n_inv,
@@ -764,6 +801,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         if red_out is None:
             print("no red config found; nothing to save", file=sys.stderr)
             return 1
+        if ddig is not None:
+            # Replay artifacts carry their provenance: which defense this
+            # spec is red against, and which search found it.
+            red_out = dataclasses.replace(red_out, meta=dict(
+                red_out.meta or {},
+                defense=args.defense,
+                defense_digest=ddig,
+                found_by="scenario_fuzz",
+                search_seed=args.seed,
+            ))
         with open(args.save_red, "w") as f:
             f.write(red_out.to_json())
         summary["saved"] = args.save_red
