@@ -75,7 +75,7 @@ CPU_SCALE = dict(n_peers=16_384, n_slots=32, degree=16,
 # spread giving an epidemic diameter of ~n_peers / (2 * (n_peers // 32))
 # = ~16 rounds, hence the longer rollout.  ``tests/test_placement.py``
 # asserts the >=50% cut-reduction margin on this exact fixed-seed mesh.
-SHARDED_SCALE = dict(n_peers=102_400, n_devices=8, n_slots=32, degree=16,
+SHARDED_SCALE = dict(n_peers=204_800, n_devices=8, n_slots=32, degree=16,
                      steps=48, topo_seed=0, reps=2)
 SHARDED_RUN_TIMEOUT_S = 1500.0
 
@@ -151,6 +151,14 @@ LIVE_OBS_RUN_TIMEOUT_S = 600.0
 # canon run (tuned + 3 statics, all sharing one warm jit cache) takes
 # ~40s on CPU, so the budget is generous headroom, not expectation.
 CONTROLLER_RUN_TIMEOUT_S = 600.0
+
+# Per-buffer memory audit (BENCH_MODE=mem, r22): exact resident bytes per
+# plane for every model family, narrow vs legacy-int32 index storage, with
+# the gossipsub rollout compiled for XLA memory_analysis totals.  The
+# eval_shape walk is cheap; the per-family inits and the one compile
+# dominate, so the budget mirrors the controller child's.
+MEM_AUDIT_PEERS = 4096
+MEM_RUN_TIMEOUT_S = 900.0
 
 PROBE_TIMEOUT_S = 180.0
 # The r3 TPU run took ~4.5 min, and the r5 child adds the device-kernel
@@ -370,6 +378,19 @@ def _run_controller_child() -> dict:
     return {"error": f"controller attempt: {tail}"[:400]}
 
 
+def _run_mem_child() -> dict:
+    """Run the BENCH_MODE=mem child (per-buffer resident-memory audit).
+    The audit is shape/dtype bookkeeping plus one backend-agnostic compile,
+    so the child runs straight on the CPU pin; failure becomes an
+    ``error`` dict, never a crash."""
+    parsed, tail = run_child(
+        {"BENCH_MODE": "mem", "JAX_PLATFORMS": "cpu"}, MEM_RUN_TIMEOUT_S
+    )
+    if parsed is not None:
+        return parsed
+    return {"error": f"mem attempt: {tail}"[:400]}
+
+
 def orchestrate() -> None:
     attempts = []
     record = None
@@ -443,6 +464,12 @@ def orchestrate() -> None:
     if os.environ.get("BENCH_CONTROLLER", "1") != "0":
         log("orchestrator: running controller child (BENCH_MODE=controller)")
         record["controller"] = _run_controller_child()
+
+    # Per-buffer memory audit rides along the same way
+    # (tools/perf_diff.py diffs it; BENCH_MEM=0 skips it).
+    if os.environ.get("BENCH_MEM", "1") != "0":
+        log("orchestrator: running mem child (BENCH_MODE=mem)")
+        record["mem"] = _run_mem_child()
 
     print(json.dumps(record))
 
@@ -582,6 +609,12 @@ def phase_breakdown(gs, st, reps, timer=None):
     from go_libp2p_pubsub_tpu.utils.trace import StepTimer
 
     p, sp = gs.params, gs.score_params
+    # The sub-phase kernels below take the WIDE kernel view of the index
+    # planes (int32, -1 sentinel) — the same view the heartbeat itself
+    # computes on; the public entry points widen/narrow at their boundaries,
+    # so ``gs.run`` below must see the STORAGE view (its scan carries it).
+    st_storage = st
+    st = jax.jit(gs._widen_indices)(st)
     timer = timer if timer is not None else StepTimer()
     phase_names = []
 
@@ -605,10 +638,10 @@ def phase_breakdown(gs, st, reps, timer=None):
         return gs.run(s, hb_steps)
 
     f = jax.jit(full_cycle)
-    jax.block_until_ready(f(st))
+    jax.block_until_ready(f(st_storage))
     for _ in range(max(1, reps // 2)):
         with timer("round_cycle"):
-            timer.fence(f(st))
+            timer.fence(f(st_storage))
     timeit("propagate", gs._propagate, st)
     timeit("heartbeat", gs._heartbeat, st)
 
@@ -726,6 +759,8 @@ def sharded_phase_breakdown(sg, st, reps):
     from go_libp2p_pubsub_tpu.ops import gossip_packed as gp
 
     split_model = sg.model
+    # The raw kernels below expect the wide index view (see phase_breakdown).
+    st = jax.jit(split_model._widen_indices)(st)
     # Same params + peer_uid, no split-gather mesh: the baseline lowering.
     # Topology rides in ``st``, so the builder is never invoked.
     was = sg.split_gather
@@ -830,6 +865,11 @@ def sharded_child_main() -> None:
     log(f"signed window + native verify: {time.perf_counter()-t0:.1f}s "
         f"(charged {verify_dt*1e3:.2f} ms)")
 
+    # BENCH_SHARDED_IDX=int32 forces the legacy wide index planes — the
+    # reference arm for costing the r22 narrow storage (auto by default).
+    idx_override = (
+        np.int32 if os.environ.get("BENCH_SHARDED_IDX") == "int32" else None
+    )
     sg = ShardedGossipSub(
         n_peers=n_peers,
         n_devices=n_dev,
@@ -839,6 +879,7 @@ def sharded_child_main() -> None:
         conn_degree=cfg["degree"],
         msg_window=N_MSGS,
         builder=build_topology_local,
+        index_dtype_override=idx_override,
     )
     t0 = time.perf_counter()
     st = sg.init(seed=cfg["topo_seed"])
@@ -882,7 +923,19 @@ def sharded_child_main() -> None:
         "state_bytes_total": int(
             sum(x.nbytes for x in jax.tree.leaves(st))
         ),
+        # r22: narrow index planes — the standing resident-bytes row the
+        # memory audit tracks (nbrs + rev at their storage dtype).  Unlike
+        # the memory_analysis fields above this is WHOLE-MODEL bytes: st
+        # holds the global [N, K] planes, not one shard.
+        "index_plane_bytes": int(st.nbrs.nbytes + st.rev.nbytes),
+        "index_plane_dtypes": [str(st.nbrs.dtype), str(st.rev.dtype)],
     }
+    # The measured alias fraction rides the JSON even when the assertion
+    # passes — a silent regression toward partial donation is visible in
+    # the record, not just at the failure cliff.
+    rollout_mem["alias_frac"] = round(
+        rollout_mem["alias_bytes"] / max(rollout_mem["argument_bytes"], 1), 4
+    )
     assert rollout_mem["alias_bytes"] >= 0.9 * rollout_mem["argument_bytes"], (
         f"rollout input state not donated: alias {rollout_mem['alias_bytes']}"
         f" of argument {rollout_mem['argument_bytes']} bytes"
@@ -1982,6 +2035,31 @@ def controller_child_main() -> None:
     print(json.dumps(record), flush=True)
 
 
+def mem_child_main() -> None:
+    """BENCH_MODE=mem: per-buffer resident-memory audit (ISSUE 20 r22).
+
+    Thin wrapper over ``tools/mem_audit.run_audit`` so the bench record and
+    the CLI tool can never drift: every family audited narrow-vs-int32 at a
+    modest exact N, extrapolated to the 65534 / 204800 / 1M peer targets,
+    with the gossipsub rollout compiled for XLA memory_analysis totals.
+    """
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tools.mem_audit import DEFAULT_MODELS, run_audit
+
+    record = run_audit(
+        DEFAULT_MODELS, n_peers=MEM_AUDIT_PEERS, n_slots=32, degree=16,
+        msg_window=64, targets=[65_534, 204_800, 1_000_000],
+        compile_rollout=True,
+    )
+    # The per-buffer tables are the CLI tool's job; the bench record keeps
+    # the standing plane/reduction numbers diff-able without ballooning
+    # benchmarks.json with hundreds of buffer rows per round.
+    for fam in record["models"].values():
+        for arm in ("narrow", "int32"):
+            fam[arm].pop("buffers", None)
+    print(json.dumps(record), flush=True)
+
+
 def child_main() -> None:
     mode = os.environ.get("BENCH_MODE", "tpu")
     if mode == "sharded":
@@ -1996,6 +2074,8 @@ def child_main() -> None:
         return live_obs_child_main()
     if mode == "controller":
         return controller_child_main()
+    if mode == "mem":
+        return mem_child_main()
     scale = TPU_SCALE if mode == "tpu" else CPU_SCALE
 
     import jax

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops import schedule as sched
+from ..ops.graphs import decode_index_plane
 from .gossipsub import GossipState, GossipSub
 
 
@@ -37,7 +38,9 @@ def _attacker_metrics(
 ) -> Dict[str, jax.Array]:
     """In-scan reductions: adversary mesh occupancy + score standing."""
     n = gs.n
-    att_slot = st.nbr_valid & attackers[jnp.clip(st.nbrs, 0, n - 1)]
+    att_slot = st.nbr_valid & attackers[
+        jnp.clip(decode_index_plane(st.nbrs), 0, n - 1)
+    ]
     honest = ~attackers & st.alive
     in_honest_mesh = (st.mesh & att_slot & honest[:, None]).sum()
     # Explicit masked reductions (GossipSub.masked_mean/min): NaN silently
@@ -171,7 +174,7 @@ def eclipse_attempt(
     P7 behaviour penalty).  Attackers stay alive and scoreable throughout.
     """
     n, k = gs.n, gs.k
-    nbrs_np = np.asarray(st.nbrs)
+    nbrs_np = np.asarray(decode_index_plane(np.asarray(st.nbrs)))
     mesh_np = np.asarray(st.mesh)
     att_ids = sorted(
         {int(nbrs_np[target, s]) for s in range(k) if mesh_np[target, s]}

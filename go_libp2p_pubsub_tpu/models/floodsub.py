@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.graphs import safe_gather
+from ..ops.graphs import decode_index_plane, safe_gather
 from .gossipsub import build_topology
 
 
@@ -46,8 +46,10 @@ class FloodSub:
         rng = np.random.default_rng(seed)
         nbrs, _, valid, _ = build_topology(rng, self.n, self.k, self.conn_degree)
         n, m = self.n, self.m
+        # Builders return narrow wrap-encoded planes (r22); this model keeps
+        # the legacy signed form — decode restores the -1 sentinel.
         return FloodState(
-            nbrs=jnp.asarray(nbrs, jnp.int32),
+            nbrs=jnp.asarray(decode_index_plane(nbrs), jnp.int32),
             nbr_valid=jnp.asarray(valid),
             alive=jnp.ones((n,), bool),
             have=jnp.zeros((n, m), bool),

@@ -33,7 +33,13 @@ from ..ops import gossip_packed as gossip_ops
 from ..ops import histogram as hist_ops
 from ..ops import scoring as scoring_ops
 from ..ops.gossip import heartbeat_mesh, uniform_by_uid
-from ..ops.graphs import safe_gather, top_mask
+from ..ops.graphs import (
+    decode_index_plane,
+    encode_index_plane,
+    index_dtype,
+    safe_gather,
+    top_mask,
+)
 from ..ops.px import px_rewire
 from ..ops.scoring import GlobalCounters, TopicCounters
 
@@ -52,8 +58,13 @@ class GossipState(NamedTuple):
     mesh/counters per topic); global score counters live outside the vmap.
     """
 
-    nbrs: jax.Array         # i32[N, K] connection slots -> remote peer id
-    rev: jax.Array          # i32[N, K] remote's slot index back to me
+    nbrs: jax.Array         # [N, K] connection slots -> remote peer id, in
+                            # the model's narrow index dtype (uint16 for
+                            # N <= 65534, else i32; ops.graphs.index_dtype).
+                            # -1 (no connection) is wrap-encoded in unsigned
+                            # storage; kernels consume the widened int32 view
+    rev: jax.Array          # [N, K] remote's slot index back to me, in
+                            # index_dtype(K) (uint16 at any realistic K)
     nbr_valid: jax.Array    # bool[N, K]
     outbound: jax.Array     # bool[N, K] I dialed this edge (v1.1 d_out quota)
     alive: jax.Array        # bool[N]
@@ -118,6 +129,12 @@ def build_topology(
     (nbrs, rev, nbr_valid, outbound); ``outbound[i, s]`` marks the dialing
     side of each edge (the first element of the pairing dials) — the v1.1
     ``d_out`` quota's notion of a connection I opened myself.
+
+    Index planes come back in the narrowest storage dtype for their value
+    domain (``ops.graphs.index_dtype``: uint16 for n <= 65534) with the -1
+    invalid marker wrap-encoded; ``decode_index_plane`` restores the signed
+    view.  The RNG draw order is dtype-independent, so a narrow topology is
+    value-identical to the legacy int64 one.
     """
     if degree >= k:
         raise ValueError(f"degree ({degree}) must be < slot count k ({k})")
@@ -141,7 +158,12 @@ def build_topology(
             adj[j].add(i)
             used[i] += 1
             used[j] += 1
-    return nbrs, rev, nbrs >= 0, outbound
+    return (
+        encode_index_plane(nbrs, n),
+        encode_index_plane(rev, k),
+        nbrs >= 0,
+        outbound,
+    )
 
 
 def build_topology_fast(
@@ -161,7 +183,12 @@ def build_topology_fast(
         raise ValueError(f"degree ({degree}) must be < slot count k ({k})")
     if degree == 0:
         empty = np.full((n, k), -1, np.int64)
-        return empty, empty.copy(), empty >= 0, np.zeros((n, k), bool)
+        return (
+            encode_index_plane(empty, n),
+            encode_index_plane(empty, k),
+            empty >= 0,
+            np.zeros((n, k), bool),
+        )
     pairs = []
     for _ in range(degree):
         perm = rng.permutation(n).astype(np.int64)
@@ -212,7 +239,12 @@ def _assign_slots(
     rev_sorted = np.empty(len(src_s), np.int64)
     rev_sorted[o2] = slot_s[o2].reshape(-1, 2)[:, ::-1].reshape(-1)
     rev[src_s, slot_s] = rev_sorted
-    return nbrs, rev, nbrs >= 0, outbound
+    return (
+        encode_index_plane(nbrs, n),
+        encode_index_plane(rev, k),
+        nbrs >= 0,
+        outbound,
+    )
 
 
 def build_topology_local(
@@ -241,7 +273,12 @@ def build_topology_local(
         raise ValueError(f"degree ({degree}) must be < slot count k ({k})")
     if degree == 0 or n < 4:
         empty = np.full((n, k), -1, np.int64)
-        return empty, empty.copy(), empty >= 0, np.zeros((n, k), bool)
+        return (
+            encode_index_plane(empty, n),
+            encode_index_plane(empty, k),
+            empty >= 0,
+            np.zeros((n, k), bool),
+        )
     if spread is None:
         spread = max(4, n // 32)
     spread = int(min(spread, max(1, n // 2 - 1)))
@@ -276,10 +313,12 @@ def compute_edge_live(
     per-element gather runs per event, not per step — at 100k peers a single
     [N, K] gather costs ~25 ms on a v5e chip, which the propagate and
     heartbeat hot loops must not pay every round.
-    """
-    from ..ops.graphs import safe_gather
 
-    return nbr_valid & safe_gather(alive, nbrs, False)
+    Accepts both the narrow wrap-encoded storage form and the wide signed
+    view (``decode_index_plane`` is the identity on signed input), so every
+    liveness-event call site works straight off the stored state.
+    """
+    return nbr_valid & safe_gather(alive, decode_index_plane(nbrs), False)
 
 
 def seed_message(
@@ -334,12 +373,32 @@ class GossipSub:
         peer_uid: Optional[np.ndarray] = None,
         split_gather_mesh=None,
         fused_prologue: Optional[bool] = None,
+        index_dtype_override=None,
     ):
         self.n = n_peers
         self.k = n_slots
         self.m = msg_window
         self.w = bitpack.n_words(msg_window)
         self.conn_degree = conn_degree
+        # Narrow index-plane storage (r22): nbrs (peer ids, sentinel -1)
+        # stores in index_dtype(N), rev (slot back-pointers) in
+        # index_dtype(K) — uint16 up to 65534 values, halving the dominant
+        # O(N*K) resident planes.  Kernels always consume the widened int32
+        # view (decode at the jitted boundary), so results are bit-identical
+        # to the int32 path; pass ``index_dtype_override=np.int32`` to force
+        # the legacy wide storage (the identity tests' reference arm).
+        if index_dtype_override is None:
+            self.idx_dtype = index_dtype(n_peers)
+            self.rev_dtype = index_dtype(n_slots)
+        else:
+            dt = np.dtype(index_dtype_override)
+            if dt.kind == "u" and n_peers + 1 > np.iinfo(dt).max:
+                raise ValueError(
+                    f"index_dtype_override={dt.name} cannot hold "
+                    f"n + 1 = {n_peers + 1} (max {np.iinfo(dt).max})"
+                )
+            self.idx_dtype = dt
+            self.rev_dtype = dt
         self.params = params or GossipSubParams()
         self.score_params = score_params or ScoreParams()
         self.heartbeat_steps = heartbeat_steps
@@ -442,6 +501,7 @@ class GossipSub:
             type(self), self.n, self.k, self.m, self.conn_degree,
             self.params, self.score_params, self.heartbeat_steps,
             self.use_pallas, self.max_edge_delay, self.fused_prologue,
+            str(self.idx_dtype), str(self.rev_dtype),
             None if self.graft_spammers is None
             else bytes(np.asarray(self.graft_spammers)),
             None if self.direct_edges is None
@@ -474,9 +534,12 @@ class GossipSub:
             build_topology if self.n <= 4096 else build_topology_fast
         )
         nbrs, rev, valid, outbound = builder(rng, self.n, self.k, self.conn_degree)
+        # encode accepts both builder forms (narrow wrap-encoded or legacy
+        # signed) and re-encodes into THIS model's storage dtype, validating
+        # the id range rather than wrapping silently.
         return (
-            jnp.asarray(nbrs, jnp.int32),
-            jnp.asarray(rev, jnp.int32),
+            jnp.asarray(encode_index_plane(nbrs, self.n, dtype=self.idx_dtype)),
+            jnp.asarray(encode_index_plane(rev, self.k, dtype=self.rev_dtype)),
             jnp.asarray(valid),
             jnp.asarray(outbound),
         )
@@ -496,8 +559,8 @@ class GossipSub:
             nv = np.asarray(valid)
             if (de & ~nv).any():
                 raise ValueError("direct_edges marks an unwired slot")
-            jn = np.clip(np.asarray(nbrs), 0, n - 1)
-            rv = np.clip(np.asarray(rev), 0, k - 1)
+            jn = np.clip(decode_index_plane(np.asarray(nbrs)), 0, n - 1)
+            rv = np.clip(decode_index_plane(np.asarray(rev)), 0, k - 1)
             if (de != (de[jn, rv] & nv)).any():
                 raise ValueError(
                     "direct_edges must be symmetric over the slot pairing"
@@ -514,7 +577,7 @@ class GossipSub:
             alive=alive0,
             subscribed=sub0,
             edge_live=compute_edge_live(valid, nbrs, alive0),
-            nbr_sub=valid & safe_gather(sub0, nbrs, False),
+            nbr_sub=valid & safe_gather(sub0, decode_index_plane(nbrs), False),
             mesh=jnp.zeros((n, k), bool),
             fanout=jnp.zeros((n, k), bool),
             fanout_age=jnp.full((n,), jnp.iinfo(jnp.int32).max // 2, jnp.int32),
@@ -554,9 +617,41 @@ class GossipSub:
         # Converge the mesh before traffic: a few warmup heartbeats.
         return self._warmup(st)
 
+    # -- narrow index storage <-> wide kernel view --------------------------
+
+    def _has_narrow_indices(self) -> bool:
+        return self.idx_dtype.kind == "u" or self.rev_dtype.kind == "u"
+
+    def _widen_indices(self, st: GossipState) -> GossipState:
+        """Narrow-storage state -> the wide int32 view every internal kernel
+        (``_propagate`` / ``_heartbeat`` / the packed and Pallas paths)
+        consumes.  On the legacy int32 path this is the identity, so the
+        interior compute graph is byte-for-byte today's — the bit-identity
+        guarantee of the narrow storage reduces to decode/encode round-trip
+        correctness at the boundary."""
+        if not self._has_narrow_indices():
+            return st
+        return st._replace(
+            nbrs=decode_index_plane(st.nbrs),
+            rev=decode_index_plane(st.rev),
+        )
+
+    def _narrow_indices(self, st: GossipState) -> GossipState:
+        """Wide int32 view -> narrow storage at the jitted exit.  Values are
+        in [-1, n-1] by construction inside the kernels, so the plain cast's
+        two's-complement wrap of -1 is exactly the encode."""
+        if not self._has_narrow_indices():
+            return st
+        return st._replace(
+            nbrs=st.nbrs.astype(self.idx_dtype),
+            rev=st.rev.astype(self.rev_dtype),
+        )
+
     @functools.partial(jax.jit, static_argnums=0)
     def _warmup(self, st: GossipState) -> GossipState:
-        return self._heartbeat(self._heartbeat(self._heartbeat(st)))
+        st = self._widen_indices(st)
+        st = self._heartbeat(self._heartbeat(self._heartbeat(st)))
+        return self._narrow_indices(st)
 
     # -- views --------------------------------------------------------------
 
@@ -644,7 +739,7 @@ class GossipSub:
         # only when a bit was actually placed (``valid`` — an invalid
         # publish must not touch victims' receive latency).
         bm = bitpack.bit_mask(slot, self.w)                      # u32[W]
-        rows = jnp.where(targets, st.nbrs[src], n)
+        rows = jnp.where(targets, decode_index_plane(st.nbrs[src]), n)
         rows_c = jnp.clip(rows, 0, n - 1)
         gathered = pend_w[rows_c]                                # u32[K, W]
         upd = gathered | jnp.where(valid, bm, jnp.uint32(0))[None, :]
@@ -753,7 +848,9 @@ class GossipSub:
         spec moves fanout peers into the mesh on join — here the next
         heartbeat grafts from scratch, which converges the same way).
         """
-        nbr_sub = st.nbr_valid & safe_gather(sub, st.nbrs, False)
+        nbr_sub = st.nbr_valid & safe_gather(
+            sub, decode_index_plane(st.nbrs), False
+        )
         return st._replace(
             subscribed=sub,
             nbr_sub=nbr_sub,
@@ -1216,6 +1313,7 @@ class GossipSub:
     def step(self, st: GossipState) -> GossipState:
         """One network round: eager-push propagation, plus heartbeat
         maintenance every ``heartbeat_steps`` rounds."""
+        st = self._widen_indices(st)
         st = self._propagate(st)
         st = jax.lax.cond(
             (st.step % self.heartbeat_steps) == self.heartbeat_steps - 1,
@@ -1223,7 +1321,7 @@ class GossipSub:
             lambda s: s,
             st,
         )
-        return st._replace(step=st.step + 1)
+        return self._narrow_indices(st._replace(step=st.step + 1))
 
     @functools.partial(jax.jit, static_argnums=0)
     def step_recorded(self, st: GossipState):
@@ -1235,6 +1333,7 @@ class GossipSub:
         already builds), so a recorded rollout stays bit-identical to a
         bare one.
         """
+        st = self._widen_indices(st)
         st, per_msg = self._propagate(st, with_receipts=True)
         st = jax.lax.cond(
             (st.step % self.heartbeat_steps) == self.heartbeat_steps - 1,
@@ -1242,7 +1341,7 @@ class GossipSub:
             lambda s: s,
             st,
         )
-        return st._replace(step=st.step + 1), per_msg
+        return self._narrow_indices(st._replace(step=st.step + 1)), per_msg
 
     @functools.partial(jax.jit, static_argnames=("self", "n_steps"))
     def run(self, st: GossipState, n_steps: int) -> GossipState:
@@ -1344,7 +1443,9 @@ class GossipSub:
         def upd_sub(s):
             # set_subscribed's body inlined on the delta-composed mask.
             sub = (s.subscribed & ~ev.sub_off) | ev.sub_on
-            nbr_sub = s.nbr_valid & safe_gather(sub, s.nbrs, False)
+            nbr_sub = s.nbr_valid & safe_gather(
+                sub, decode_index_plane(s.nbrs), False
+            )
             return s._replace(
                 subscribed=sub,
                 nbr_sub=nbr_sub,
@@ -1403,7 +1504,7 @@ class GossipSub:
         (the in-scan reductions the attack runners assert on)."""
         if attackers is not None:
             att_slot = st.nbr_valid & attackers[
-                jnp.clip(st.nbrs, 0, self.n - 1)
+                jnp.clip(decode_index_plane(st.nbrs), 0, self.n - 1)
             ]
             honest = ~attackers & st.alive
             honest_mesh = st.mesh & st.nbr_valid & honest[:, None]
@@ -1440,7 +1541,7 @@ class GossipSub:
             tgt_edges = st.mesh[target] & st.nbr_valid[target]
             if attackers is not None:
                 tgt_edges = tgt_edges & ~attackers[
-                    jnp.clip(st.nbrs[target], 0, self.n - 1)
+                    jnp.clip(decode_index_plane(st.nbrs[target]), 0, self.n - 1)
                 ]
             rec["target_honest_mesh_edges"] = tgt_edges.sum().astype(jnp.int32)
         return rec

@@ -117,6 +117,7 @@ class HybridGossipSub:
         builder=None,
         peer_uid: Optional[np.ndarray] = None,
         use_mxu: Optional[bool] = None,
+        index_dtype_override=None,
     ):
         if not (1 <= gen_size <= 255):
             raise ValueError(f"gen_size must be in [1, 255], got {gen_size}")
@@ -142,6 +143,7 @@ class HybridGossipSub:
             use_pallas=False,
             builder=builder,
             peer_uid=peer_uid,
+            index_dtype_override=index_dtype_override,
         )
         self.gen_size = gen_size
         self.switch_hi = float(switch_hi)
@@ -261,10 +263,23 @@ class HybridGossipSub:
 
     # -- one round ----------------------------------------------------------
 
+    # Narrow index storage (r22): ``_step_core`` and ``_finish_round`` expect
+    # the embedded gossip state in the WIDE kernel view (int32 nbrs/rev with
+    # the -1 sentinel) — the public step/rollout entry points widen at entry
+    # and narrow back at exit, matching GossipSub's own boundary convention,
+    # so the scan carry stays narrow.
+    def _widen(self, st: HybridState) -> HybridState:
+        return st._replace(gossip=self.gs._widen_indices(st.gossip))
+
+    def _narrow(self, st: HybridState) -> HybridState:
+        return st._replace(gossip=self.gs._narrow_indices(st.gossip))
+
     def _step_core(self, st: HybridState, with_receipts: bool = False):
         """One hybrid network round (pre-heartbeat, pre-step-increment):
         gated eager propagate, cond-gated coded fold + decode merge, and the
-        loss-estimator update.  Returns ``(state, per_msg | None)``."""
+        loss-estimator update.  Returns ``(state, per_msg | None)``.  The
+        embedded gossip state must be in the wide kernel view (see
+        :meth:`_widen`)."""
         g = st.gossip
         n, k, m, kg = self.n, self.k, self.m, self.gen_size
         # Per-receiver ingress decimation gate, the r11 RLNC convention:
@@ -402,15 +417,15 @@ class HybridGossipSub:
 
     @functools.partial(jax.jit, static_argnums=0)
     def step(self, st: HybridState) -> HybridState:
-        st, _ = self._step_core(st)
-        return self._finish_round(st)
+        st, _ = self._step_core(self._widen(st))
+        return self._narrow(self._finish_round(st))
 
     @functools.partial(jax.jit, static_argnums=0)
     def step_recorded(self, st: HybridState):
         """``step`` plus the receipt tap (eager stampings + coded decode
         completions this round) — same state graph as ``step``."""
-        st, per_msg = self._step_core(st, with_receipts=True)
-        return self._finish_round(st), per_msg
+        st, per_msg = self._step_core(self._widen(st), with_receipts=True)
+        return self._narrow(self._finish_round(st)), per_msg
 
     # -- rollouts -----------------------------------------------------------
 
@@ -506,8 +521,8 @@ class HybridGossipSub:
         if not record:
             def bare(s, ev):
                 s = apply_events(s, ev)
-                s, _ = self._step_core(s)
-                return self._finish_round(s), None
+                s, _ = self._step_core(self._widen(s))
+                return self._narrow(self._finish_round(s)), None
 
             return jax.lax.scan(bare, st, events, length=n_steps)
 
@@ -531,13 +546,13 @@ class HybridGossipSub:
                 & s.gossip.subscribed[src_c]
             ).sum(dtype=jnp.int32)
             hist = hist.at[0].add(pub_counted)
-            s2, per_msg = self._step_core(s, with_receipts=True)
+            s2, per_msg = self._step_core(self._widen(s), with_receipts=True)
             hist = hist + hist_ops.latency_histogram_increment(
                 per_msg, s2.gossip.msg_birth,
                 s2.gossip.msg_used & s2.gossip.msg_valid,
                 s.gossip.step, FLIGHT_HIST_BINS,
             )
-            s2 = self._finish_round(s2)
+            s2 = self._narrow(self._finish_round(s2))
             return (s2, hist), self.flight_record_round(s2, hist)
 
         (final, _), ys = jax.lax.scan(body, (st, hist0), events, length=n_steps)

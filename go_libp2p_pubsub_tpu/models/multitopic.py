@@ -38,13 +38,15 @@ from ..ops import gossip_packed as gossip_ops
 from ..ops import scoring as scoring_ops
 from ..ops.gossip import heartbeat_mesh
 from ..ops.scoring import GlobalCounters, TopicCounters
+from ..ops.graphs import decode_index_plane
 from .gossipsub import GossipState, GossipSub, compute_edge_live
 
 
 class MultiTopicState(NamedTuple):
     # shared
-    nbrs: jax.Array          # i32[N, K]
-    rev: jax.Array           # i32[N, K]
+    nbrs: jax.Array          # [N, K] narrow index storage (uint16 for
+                             # N <= 65534; see GossipState.nbrs)
+    rev: jax.Array           # [N, K] narrow slot back-pointers
     nbr_valid: jax.Array     # bool[N, K]
     outbound: jax.Array      # bool[N, K] dialed-by-me (shared: connections,
                              # not meshes, have a direction)
@@ -145,6 +147,7 @@ class MultiTopicGossipSub:
         params: Optional[GossipSubParams] = None,
         score_params: Optional[ScoreParams] = None,
         heartbeat_steps: int = 8,
+        index_dtype_override=None,
     ):
         self.t = n_topics
         self.gs = GossipSub(
@@ -156,6 +159,7 @@ class MultiTopicGossipSub:
             score_params=score_params,
             heartbeat_steps=heartbeat_steps,
             use_pallas=False,
+            index_dtype_override=index_dtype_override,
         )
         self.n, self.k, self.m, self.w = (
             self.gs.n, self.gs.k, self.gs.m, self.gs.w,
@@ -229,9 +233,32 @@ class MultiTopicGossipSub:
         )
         return self._warmup(st)
 
+    # Narrow index storage <-> wide kernel view (see GossipSub): the state
+    # carries nbrs/rev in the inner model's narrow dtypes; _propagate and
+    # _heartbeat consume the widened int32 view, restored at every public
+    # jitted boundary so the interior graphs match the legacy int32 path
+    # byte-for-byte.
+    def _widen_indices(self, st: MultiTopicState) -> MultiTopicState:
+        if not self.gs._has_narrow_indices():
+            return st
+        return st._replace(
+            nbrs=decode_index_plane(st.nbrs),
+            rev=decode_index_plane(st.rev),
+        )
+
+    def _narrow_indices(self, st: MultiTopicState) -> MultiTopicState:
+        if not self.gs._has_narrow_indices():
+            return st
+        return st._replace(
+            nbrs=st.nbrs.astype(self.gs.idx_dtype),
+            rev=st.rev.astype(self.gs.rev_dtype),
+        )
+
     @functools.partial(jax.jit, static_argnums=0)
     def _warmup(self, st: MultiTopicState) -> MultiTopicState:
-        return self._heartbeat(self._heartbeat(self._heartbeat(st)))
+        st = self._widen_indices(st)
+        st = self._heartbeat(self._heartbeat(self._heartbeat(st)))
+        return self._narrow_indices(st)
 
     # -- events -------------------------------------------------------------
 
@@ -293,7 +320,7 @@ class MultiTopicGossipSub:
         # Hold arming mirrors the single-topic publish exactly: only on an
         # idle empty row, only when a bit was placed (see GossipSub.publish).
         bm = bitpack.bit_mask(slot, self.w)
-        rows = jnp.where(targets, st.nbrs[src], n)
+        rows = jnp.where(targets, decode_index_plane(st.nbrs[src]), n)
         rows_c = jnp.clip(rows, 0, n - 1)
         gathered = pend_t[rows_c]
         upd = gathered | jnp.where(valid, bm, jnp.uint32(0))[None, :]
@@ -564,6 +591,7 @@ class MultiTopicGossipSub:
 
     @functools.partial(jax.jit, static_argnums=0)
     def step(self, st: MultiTopicState) -> MultiTopicState:
+        st = self._widen_indices(st)
         st = self._propagate(st)
         st = jax.lax.cond(
             (st.step % self.heartbeat_steps) == self.heartbeat_steps - 1,
@@ -571,7 +599,7 @@ class MultiTopicGossipSub:
             lambda s: s,
             st,
         )
-        return st._replace(step=st.step + 1)
+        return self._narrow_indices(st._replace(step=st.step + 1))
 
     @functools.partial(jax.jit, static_argnames=("self", "n_steps"))
     def run(self, st: MultiTopicState, n_steps: int) -> MultiTopicState:

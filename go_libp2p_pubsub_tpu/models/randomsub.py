@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.graphs import safe_gather, top_mask
+from ..ops.graphs import decode_index_plane, safe_gather, top_mask
 from .floodsub import FloodSub
 from .gossipsub import build_topology
 
@@ -79,9 +79,11 @@ class RandomSub(FloodSub):
             rng, self.n, self.k, self.conn_degree
         )
         n, m = self.n, self.m
+        # Builders return narrow wrap-encoded planes (r22); this model keeps
+        # the legacy signed form — decode restores the -1 sentinel.
         return RandomSubState(
-            nbrs=jnp.asarray(nbrs, jnp.int32),
-            rev=jnp.asarray(rev, jnp.int32),
+            nbrs=jnp.asarray(decode_index_plane(nbrs), jnp.int32),
+            rev=jnp.asarray(decode_index_plane(rev), jnp.int32),
             nbr_valid=jnp.asarray(valid),
             alive=jnp.ones((n,), bool),
             have=jnp.zeros((n, m), bool),

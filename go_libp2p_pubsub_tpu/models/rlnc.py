@@ -56,6 +56,7 @@ import numpy as np
 
 from ..ops import gf256
 from ..ops import histogram as hist_ops
+from ..ops.graphs import decode_index_plane, encode_index_plane, index_dtype
 from .gossipsub import (
     FLIGHT_HIST_BINS,
     build_topology,
@@ -68,8 +69,9 @@ class RLNCState(NamedTuple):
     """Coded-gossip state: N peers, K neighbor slots, G generations in the
     message window, Kg = ``gen_size`` source fragments per generation."""
 
-    nbrs: jax.Array        # i32[N, K] connection slots -> remote peer id
-    rev: jax.Array         # i32[N, K] remote's slot index back to me
+    nbrs: jax.Array        # [N, K] connection slots -> remote peer id, in
+    #                        narrow index storage (see GossipState.nbrs)
+    rev: jax.Array         # [N, K] remote's slot index back to me (narrow)
     nbr_valid: jax.Array   # bool[N, K]
     alive: jax.Array       # bool[N]
     subscribed: jax.Array  # bool[N] topic membership
@@ -107,6 +109,7 @@ class RLNC:
         builder=None,
         peer_uid: Optional[np.ndarray] = None,
         use_mxu: Optional[bool] = None,
+        index_dtype_override=None,
     ):
         if gen_size < 1:
             raise ValueError("gen_size must be >= 1")
@@ -126,6 +129,21 @@ class RLNC:
         self.gen_size = gen_size  # Kg source fragments per generation
         self.conn_degree = conn_degree
         self.builder = builder    # explicit topology builder (seed pinning)
+        # r22: narrow index storage (same scheme as GossipSub) — topology is
+        # static here (no PX), so the planes are encoded once at build_graph
+        # and decoded in-kernel at their two read sites.
+        if index_dtype_override is None:
+            self.idx_dtype = index_dtype(n_peers)
+            self.rev_dtype = index_dtype(n_slots)
+        else:
+            dt = np.dtype(index_dtype_override)
+            if dt.kind == "u" and n_peers + 1 > np.iinfo(dt).max:
+                raise ValueError(
+                    f"index_dtype_override {dt.name} cannot hold "
+                    f"n_peers + 1 = {n_peers + 1}"
+                )
+            self.idx_dtype = dt
+            self.rev_dtype = dt
         if peer_uid is None:
             self.peer_uid = None
         else:
@@ -144,6 +162,7 @@ class RLNC:
         return (
             type(self), self.n, self.k, self.m, self.gen_size,
             self.conn_degree, self.use_mxu,
+            str(self.idx_dtype), str(self.rev_dtype),
             None if self.peer_uid is None
             else bytes(np.asarray(self.peer_uid)),
         )
@@ -173,8 +192,8 @@ class RLNC:
             rng, self.n, self.k, self.conn_degree
         )
         return (
-            jnp.asarray(nbrs, jnp.int32),
-            jnp.asarray(rev, jnp.int32),
+            jnp.asarray(encode_index_plane(nbrs, self.n, dtype=self.idx_dtype)),
+            jnp.asarray(encode_index_plane(rev, self.k, dtype=self.rev_dtype)),
             jnp.asarray(valid),
         )
 
@@ -323,8 +342,8 @@ class RLNC:
 
         # Receiver gather: in-slot s of peer i carries sender j = nbrs[i,s]
         # and j's fragment for THIS edge sits at j's out-slot rev[i,s].
-        j = jnp.clip(st.nbrs, 0, n - 1)
-        flat_idx = j * k + jnp.clip(st.rev, 0, k - 1)       # i32[N, K]
+        j = jnp.clip(decode_index_plane(st.nbrs), 0, n - 1)
+        flat_idx = j * k + jnp.clip(decode_index_plane(st.rev), 0, k - 1)  # i32[N, K]
         incoming = frag_out.reshape(n * k, g, kg)[flat_idx]  # u8[N, K, G, Kg]
         sender_ok = can_send[j]                              # bool[N, K, G]
 

@@ -11,11 +11,85 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 # Plain Python ints: converted inside traced code; creating device arrays at
 # import time would initialize a jax backend as a side effect of `import`.
 INVALID = -1
 BIG_I32 = 2**31 - 1
+
+
+def index_dtype(n: int) -> np.dtype:
+    """Narrowest storage dtype that holds every peer-index value for ``n``
+    peers: the ids ``0..n-1``, the segment-sum sentinel row ``n``, and the
+    wrap-encoded ``-1`` invalid marker — i.e. the smallest dtype whose range
+    covers ``n + 1`` distinct non-negative values plus one sentinel.
+
+    uint16 for ``n <= 65534`` (ids <= 65533 in builders, sentinel row
+    ``n <= 65534``, and ``-1`` wraps to 65535 — all distinct exactly when
+    ``n + 1 <= 65535``), int32 above.  Raises instead of silently wrapping
+    when even int32 cannot hold ``n + 1``.
+
+    Storage stays narrow; kernel arithmetic (e.g. the composite-key trick in
+    :func:`segment_rank`, ``key * (n + 1) + arange``) always widens to int32
+    first, so narrow-plane results are bit-identical to the int32 path.
+    """
+    if n < 0:
+        raise ValueError(f"index_dtype: peer count must be >= 0, got {n}")
+    if n + 1 <= np.iinfo(np.uint16).max:
+        return np.dtype(np.uint16)
+    if n + 1 <= np.iinfo(np.int32).max:
+        return np.dtype(np.int32)
+    raise ValueError(
+        f"index_dtype: n + 1 = {n + 1} exceeds int32; no supported index "
+        f"storage dtype can hold it"
+    )
+
+
+def encode_index_plane(arr, n: int, dtype=None) -> np.ndarray:
+    """Host-side: a ``-1``-sentinel signed index plane -> narrow storage.
+
+    Validates the value range first and raises a clear error rather than
+    silently wrapping out-of-range ids: every entry must be in
+    ``[-1, n - 1]`` (builder ids) — the sole negative value ``-1`` is
+    wrap-encoded to the unsigned dtype's max (65535 for uint16), which can
+    never collide with a valid id because :func:`index_dtype` only selects
+    uint16 when ``n + 1 <= 65535``.
+
+    ``dtype`` overrides the auto selection (e.g. ``np.int32`` to force the
+    legacy wide path for identity testing); forcing a dtype too narrow for
+    ``n`` raises.
+    """
+    dt = np.dtype(dtype) if dtype is not None else index_dtype(n)
+    if dt.kind == "u" and n + 1 > np.iinfo(dt).max:
+        raise ValueError(
+            f"encode_index_plane: n + 1 = {n + 1} exceeds {dt.name} storage "
+            f"(max {np.iinfo(dt).max}); use index_dtype(n) or int32"
+        )
+    a = np.asarray(arr)
+    if a.dtype.kind == "u":  # already wrap-encoded: restore -1 first
+        a = decode_index_plane(a)
+    if a.size and (a.min() < -1 or a.max() >= n):
+        raise ValueError(
+            f"encode_index_plane: values outside [-1, {n - 1}] "
+            f"(got min={a.min()}, max={a.max()}) would wrap silently"
+        )
+    return a.astype(dt)
+
+
+def decode_index_plane(arr):
+    """Narrow index storage -> int32 with the ``-1`` sentinel restored.
+
+    Works on both host numpy arrays and traced jax values; signed input
+    (the legacy int32 path, or builder int64) is a plain cast, so the
+    decoded plane is byte-identical either way and XLA elides the no-op.
+    """
+    xp = jnp if isinstance(arr, jax.Array) else np
+    if np.dtype(arr.dtype).kind == "u":
+        sentinel = np.iinfo(arr.dtype).max
+        wide = arr.astype(xp.int32)
+        return xp.where(wide == sentinel, xp.int32(-1), wide)
+    return arr.astype(xp.int32)
 
 
 def segment_rank(targets: jax.Array, mask: jax.Array) -> jax.Array:

@@ -30,13 +30,20 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from ..ops.graphs import decode_index_plane, encode_index_plane
+
 
 def _edge_list(nbrs: np.ndarray, mask: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-    """Directed (src, dst) arrays of the masked slots of a neighbor table."""
+    """Directed (src, dst) arrays of the masked slots of a neighbor table.
+
+    Accepts both the legacy signed (-1 invalid) and the narrow wrap-encoded
+    storage form — the decode restores the sentinel before the sign test.
+    """
     n, k = nbrs.shape
+    nb = np.asarray(decode_index_plane(nbrs), np.int64)
     src = np.repeat(np.arange(n, dtype=np.int64), k).reshape(n, k)
-    sel = mask & (nbrs >= 0)
-    return src[sel], nbrs[sel].astype(np.int64)
+    sel = mask & (nb >= 0)
+    return src[sel], nb[sel]
 
 
 def _csr(n: int, src: np.ndarray, dst: np.ndarray):
@@ -133,14 +140,18 @@ def relabel_topology(
     computation is untouched by the relabeling), with neighbor ids mapped
     into the new numbering.  Invalid slots (-1) stay -1; the slot-pairing
     invariant ``nbrs[nbrs[i, s], rev[i, s]] == i`` is preserved.
+
+    The output keeps the input's storage form: a narrow wrap-encoded table
+    relabels to the same narrow dtype (with range validation — no silent
+    wrap), the legacy signed form stays signed.
     """
     n = nbrs.shape[0]
     inv = np.empty(n, np.int64)
     inv[perm] = np.arange(n, dtype=np.int64)
-    old_rows = nbrs[perm]
+    old_rows = np.asarray(decode_index_plane(nbrs), np.int64)[perm]
     new_nbrs = np.where(old_rows >= 0, inv[np.clip(old_rows, 0, n - 1)], -1)
     return (
-        new_nbrs.astype(nbrs.dtype),
+        encode_index_plane(new_nbrs, n, dtype=nbrs.dtype),
         rev[perm].copy(),
         nbr_valid[perm].copy(),
         outbound[perm].copy(),

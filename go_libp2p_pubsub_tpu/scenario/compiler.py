@@ -41,6 +41,7 @@ import numpy as np
 
 from ..config import GossipSubParams, ScoreParams, SimParams, TreeOpts
 from ..ops import schedule as sched
+from ..ops.graphs import decode_index_plane
 from .spec import ScenarioSpec
 
 # Substream tags: each spec component draws from its own child stream, so
@@ -225,7 +226,7 @@ def _compile_gossip_like(spec: ScenarioSpec) -> CompiledScenario:
         if w.kind in _TARGETED_KINDS:
             if multitopic:
                 raise ValueError("eclipse waves are gossipsub-only")
-            nbrs = np.asarray(st.nbrs)
+            nbrs = np.asarray(decode_index_plane(np.asarray(st.nbrs)))
             if not (0 <= w.target < n):
                 raise ValueError(f"{w.kind} target {w.target} out of range")
             if w.kind == "eclipse":
@@ -284,8 +285,8 @@ def _compile_gossip_like(spec: ScenarioSpec) -> CompiledScenario:
 
         wa = wave_att[ai]
         mesh = np.asarray(st.mesh).copy()
-        nbrs = np.asarray(st.nbrs)
-        rev = np.asarray(st.rev)
+        nbrs = np.asarray(decode_index_plane(np.asarray(st.nbrs)))
+        rev = np.asarray(decode_index_plane(np.asarray(st.rev)))
         valid = np.asarray(st.nbr_valid)
         counters = jax.tree.map(lambda x: np.asarray(x).copy(), st.counters)
         for s in range(model.k):

@@ -157,7 +157,9 @@ def tree_text(st) -> str:
 def export_mesh(st) -> Dict[int, List[int]]:
     """GossipState -> {peer: sorted mesh-neighbor ids} adjacency dict."""
     mesh = np.asarray(jax.device_get(st.mesh & st.nbr_valid))
-    nbrs = np.asarray(jax.device_get(st.nbrs))
+    from ..ops.graphs import decode_index_plane
+
+    nbrs = np.asarray(decode_index_plane(jax.device_get(st.nbrs)))
     alive = np.asarray(jax.device_get(st.alive))
     out: Dict[int, List[int]] = {}
     for p in range(mesh.shape[0]):

@@ -1,0 +1,393 @@
+"""Narrow index storage + per-buffer memory audit (r22).
+
+Four layers:
+
+1. dtype selection and the overflow guard: ``index_dtype`` boundaries at
+   N = 65533/65534/65535, ``encode_index_plane`` rejecting out-of-range
+   ids and too-narrow forced dtypes loudly (no silent wrap);
+2. builder/relabel storage form: every topology builder emits wrap-encoded
+   narrow planes that decode to a valid slot-paired graph, and
+   ``relabel_topology`` preserves the storage dtype and inverts exactly
+   under the inverse permutation;
+3. bit-identity: the narrow-storage model and the forced-int32 reference
+   arm produce leaf-for-leaf identical rollouts (kill/churn events
+   included; the multi-family and sharded sweeps ride the slow tier);
+4. tools: ``mem_audit.py --json`` smoke (eval_shape only — no compile)
+   with the >= 40% index-plane acceptance pin, and ``perf_diff.py``
+   warning (never crashing) on pre-r22 records.
+"""
+
+import importlib
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from go_libp2p_pubsub_tpu.models.gossipsub import (
+    GossipSub, build_topology, build_topology_fast, build_topology_local)
+from go_libp2p_pubsub_tpu.ops import schedule as sched
+from go_libp2p_pubsub_tpu.ops.graphs import (
+    decode_index_plane, encode_index_plane, index_dtype)
+from go_libp2p_pubsub_tpu.parallel.placement import (
+    random_placement, relabel_topology)
+from go_libp2p_pubsub_tpu.scenario.realism import heavy_tailed_builder
+
+mem_audit = importlib.import_module("tools.mem_audit")
+
+
+# ---------------------------------------------------------------------------
+# dtype selection + overflow guard (satellite a)
+# ---------------------------------------------------------------------------
+
+
+def test_index_dtype_boundaries():
+    # n + 1 values must fit INCLUDING the wrap-encoded -1 sentinel: 65534
+    # is the last uint16 peer count (sentinel lands on 65535), 65535 tips
+    # over to int32.
+    assert index_dtype(65533) == np.dtype(np.uint16)
+    assert index_dtype(65534) == np.dtype(np.uint16)
+    assert index_dtype(65535) == np.dtype(np.int32)
+    assert index_dtype(0) == np.dtype(np.uint16)
+    with pytest.raises(ValueError):
+        index_dtype(-1)
+    with pytest.raises(ValueError):
+        index_dtype(2**31 - 1)
+
+
+def test_encode_decode_round_trip_at_uint16_boundary():
+    n = 65534
+    arr = np.array([-1, 0, 1, n - 1], np.int64)
+    enc = encode_index_plane(arr, n)
+    assert enc.dtype == np.uint16
+    assert int(enc[0]) == 65535  # the wrap-encoded sentinel
+    np.testing.assert_array_equal(
+        np.asarray(decode_index_plane(enc)), arr.astype(np.int32)
+    )
+
+
+def test_encode_rejects_out_of_range_and_narrow_override():
+    with pytest.raises(ValueError, match="outside"):
+        encode_index_plane(np.array([5]), 5)  # id == n (the sentinel row)
+    with pytest.raises(ValueError, match="outside"):
+        encode_index_plane(np.array([-2]), 5)
+    # Forcing a dtype that cannot hold n + 1 is a loud error, never a wrap.
+    with pytest.raises(ValueError, match="exceeds"):
+        encode_index_plane(np.array([0]), 70_000, dtype=np.uint16)
+    with pytest.raises(ValueError):
+        GossipSub(n_peers=70_000, index_dtype_override=np.uint16)
+
+
+def test_encode_idempotent_on_already_encoded_input():
+    n = 100
+    arr = np.array([-1, 3, 99], np.int64)
+    once = encode_index_plane(arr, n)
+    np.testing.assert_array_equal(once, encode_index_plane(once, n))
+    # And re-encoding into int32 restores the legacy signed view.
+    wide = encode_index_plane(once, n, dtype=np.int32)
+    np.testing.assert_array_equal(wide, arr.astype(np.int32))
+
+
+# ---------------------------------------------------------------------------
+# builders + relabeling emit narrow storage (satellite c, host level)
+# ---------------------------------------------------------------------------
+
+BUILDERS = {
+    "loop": build_topology,
+    "fast": build_topology_fast,
+    "local": build_topology_local,
+    "heavy_tailed": heavy_tailed_builder(2.5),
+}
+
+
+@pytest.mark.parametrize("name", sorted(BUILDERS))
+def test_builder_emits_narrow_valid_slot_paired_graph(name):
+    n, k, degree = 96, 8, 4
+    nbrs, rev, valid, outbound = BUILDERS[name](
+        np.random.default_rng(7), n, k, degree
+    )
+    assert nbrs.dtype == index_dtype(n) == np.dtype(np.uint16)
+    assert rev.dtype == index_dtype(k) == np.dtype(np.uint16)
+    dn = np.asarray(decode_index_plane(nbrs))
+    dr = np.asarray(decode_index_plane(rev))
+    assert dn.min() >= -1 and dn.max() < n
+    np.testing.assert_array_equal(valid, dn >= 0)
+    np.testing.assert_array_equal(dr >= 0, dn >= 0)
+    # Slot-pairing invariant on the decoded view.
+    i, s = np.nonzero(valid)
+    np.testing.assert_array_equal(dn[dn[i, s], dr[i, s]], i)
+    # Same seed, same graph: the draw order is dtype-independent.
+    nbrs2, rev2, _, _ = BUILDERS[name](np.random.default_rng(7), n, k, degree)
+    np.testing.assert_array_equal(nbrs, nbrs2)
+    np.testing.assert_array_equal(rev, rev2)
+
+
+def test_relabel_preserves_storage_and_inverts():
+    n, k = 128, 8
+    nbrs, rev, valid, outbound = build_topology_fast(
+        np.random.default_rng(3), n, k, 4
+    )
+    perm, inv = random_placement(n, seed=5)
+    rn, rr, rv, ro = relabel_topology(nbrs, rev, valid, outbound, perm)
+    assert rn.dtype == nbrs.dtype and rr.dtype == rev.dtype
+    # Relabeling by the inverse permutation restores the original exactly.
+    bn, br, bv, bo = relabel_topology(rn, rr, rv, ro, inv)
+    for a, b in ((nbrs, bn), (rev, br), (valid, bv), (outbound, bo)):
+        np.testing.assert_array_equal(a, b)
+    # The legacy signed form stays signed through a relabel.
+    wide = encode_index_plane(nbrs, n, dtype=np.int32)
+    wn, _, _, _ = relabel_topology(wide, rev, valid, outbound, perm)
+    assert wn.dtype == np.int32
+    np.testing.assert_array_equal(wn, np.asarray(decode_index_plane(rn)))
+
+
+# ---------------------------------------------------------------------------
+# narrow vs int32 bit-identity (satellite c, compiled level)
+# ---------------------------------------------------------------------------
+
+
+def _assert_states_identical(sn, sw):
+    """Leaf-for-leaf equality, comparing index planes on the decoded view
+    (they differ in storage dtype by design) and everything else bitwise."""
+    for (pa, la), (pb, lb) in zip(
+        mem_audit.walk_state(sn), mem_audit.walk_state(sw)
+    ):
+        assert pa == pb
+        a, b = np.asarray(la), np.asarray(lb)
+        if pa.split(".")[-1] in ("nbrs", "rev"):
+            a = np.asarray(decode_index_plane(a))
+            b = np.asarray(decode_index_plane(b))
+        else:
+            assert a.dtype == b.dtype, pa
+        np.testing.assert_array_equal(a, b, err_msg=pa)
+
+
+def test_gossipsub_narrow_matches_int32_with_kill_churn_events():
+    import jax.numpy as jnp
+
+    n, steps = 96, 10
+    kw = dict(n_peers=n, n_slots=8, conn_degree=4, msg_window=8,
+              heartbeat_steps=2, use_pallas=False)
+    records = {}
+    finals = {}
+    for arm, override in (("narrow", None), ("int32", np.int32)):
+        gs = GossipSub(index_dtype_override=override, **kw)
+        assert gs._has_narrow_indices() == (override is None)
+        st = gs.init(seed=1)
+        if override is None:
+            assert st.nbrs.dtype == jnp.uint16 and st.rev.dtype == jnp.uint16
+        ev = sched.empty_gossip_events(steps, n, 2)
+        ev.kill[2, 10:14] = True          # abrupt churn-out
+        ev.revive[6, 10:12] = True        # partial churn-back
+        ev.sub_off[3, 20:24] = True       # graceful leave
+        ev.sub_on[7, 20:22] = True
+        sched.add_publish(ev, 0, {"src": 5, "slot": 0, "valid": True})
+        sched.add_publish(ev, 4, {"src": 30, "slot": 1, "valid": True})
+        st, rec = gs.rollout_events(st, ev, record=True)
+        finals[arm], records[arm] = st, rec
+    _assert_states_identical(finals["narrow"], finals["int32"])
+    for key in records["narrow"]:
+        np.testing.assert_array_equal(
+            np.asarray(records["narrow"][key]),
+            np.asarray(records["int32"][key]), err_msg=key,
+        )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("family", ["multitopic", "hybrid", "rlnc"])
+def test_family_narrow_matches_int32(family):
+    finals = {}
+    for arm, override in (("narrow", None), ("int32", np.int32)):
+        model = mem_audit.build_model(
+            family, n_peers=128, n_slots=8, degree=4, msg_window=8,
+            override=override,
+        )
+        st = model.init(0)
+        for _ in range(8):
+            st = model.step(st)
+        finals[arm] = st
+    _assert_states_identical(finals["narrow"], finals["int32"])
+
+
+@pytest.mark.slow
+def test_peer_uid_relabeled_narrow_matches_int32():
+    # The relabeled model (peer_uid + relabeled builder) must stay
+    # bit-identical across storage dtypes too — uid-keyed RNG folds consume
+    # the int32 peer_uid, never the narrow planes.
+    n, k, degree = 128, 8, 4
+    base = build_topology_fast(np.random.default_rng(11), n, k, degree)
+    perm, inv = random_placement(n, seed=2)
+    relabeled = relabel_topology(*base, perm)
+    finals = {}
+    for arm, override in (("narrow", None), ("int32", np.int32)):
+        gs = GossipSub(
+            n_peers=n, n_slots=k, conn_degree=degree, msg_window=8,
+            heartbeat_steps=2, use_pallas=False, peer_uid=perm,
+            builder=lambda rng, nn, kk, dd: relabeled,
+            index_dtype_override=override,
+        )
+        st = gs.init(seed=4)
+        st = gs.run(st, 8)
+        finals[arm] = st
+    _assert_states_identical(finals["narrow"], finals["int32"])
+
+
+@pytest.mark.slow
+def test_sharded_narrow_matches_int32():
+    from go_libp2p_pubsub_tpu.parallel.gossip_sharded import ShardedGossipSub
+
+    import jax.numpy as jnp
+
+    finals = {}
+    for arm, override in (("narrow", None), ("int32", np.int32)):
+        sg = ShardedGossipSub(
+            n_peers=256, n_devices=8, n_slots=16, conn_degree=8,
+            msg_window=32, placement="bfs", index_dtype_override=override,
+        )
+        st = sg.init(seed=3)
+        if override is None:
+            assert st.nbrs.dtype == jnp.uint16
+        st = sg.publish(st, jnp.int32(0), jnp.int32(0), jnp.asarray(True))
+        st = sg.run(st, 16)
+        finals[arm] = st
+    _assert_states_identical(finals["narrow"], finals["int32"])
+
+
+# ---------------------------------------------------------------------------
+# tools: mem_audit smoke (satellite e) + perf_diff pre-r22 (satellite b)
+# ---------------------------------------------------------------------------
+
+
+def test_mem_audit_classifies_every_gossip_state_field():
+    # A new state field landing in "misc" silently would rot the audit:
+    # pin that every current GossipState leaf has an explicit plane.
+    from go_libp2p_pubsub_tpu.models.gossipsub import GossipState
+
+    for f in GossipState._fields:
+        assert f in mem_audit.PLANE_BY_FIELD, (
+            f"GossipState.{f} has no plane classification in "
+            f"tools/mem_audit.PLANE_BY_FIELD"
+        )
+    assert mem_audit.PLANE_BY_FIELD["nbrs"] == "index"
+    assert mem_audit.PLANE_BY_FIELD["rev"] == "index"
+    assert mem_audit.PLANE_BY_FIELD["nbr_valid"] == "adjacency"
+
+
+def test_mem_audit_json_smoke():
+    # eval_shape only (no --compile): the tier-1 smoke the CI knob rides.
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "mem_audit.py"),
+         "--json", "--models", "gossipsub", "--peers", "192",
+         "--slots", "8", "--degree", "4", "--window", "8",
+         "--extrapolate", "65534,1000000"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    doc = json.loads(out.stdout)
+    gs = doc["models"]["gossipsub"]
+    # The acceptance metric: >= 40% index-plane reduction at N <= 65534.
+    assert gs["index_plane_reduction"] >= 0.4
+    assert gs["nbrs_rev_reduction"] >= 0.4
+    assert gs["narrow"]["total_bytes"] < gs["int32"]["total_bytes"]
+    assert gs["narrow"]["plane_bytes"]["index"] * 2 == \
+        gs["int32"]["plane_bytes"]["index"]
+    # Extrapolation re-derives dtypes per target: at 1M peers nbrs is int32
+    # again but rev stays uint16 (its domain is the slot count).
+    ext = gs["narrow"]["extrapolated"]
+    k = doc["n_slots"]
+    assert ext["65534"]["index_plane_bytes"] == 65534 * k * (2 + 2)
+    assert ext["1000000"]["index_plane_bytes"] == 1_000_000 * k * (4 + 2)
+    # rollout_memory is compile-gated; the smoke must not have paid for it.
+    assert "rollout_memory" not in gs
+
+
+def _mem_record(with_mem, with_index_bytes=True, n_peers=4096):
+    rec = {
+        "metric": "gossipsub_100k_validated_msgs_per_sec", "value": 1000.0,
+        "sharded": {
+            "value": 5000.0, "n_peers": 204_800, "n_devices": 8,
+            "backend": "cpu",
+            "rollout_memory": {"temp_bytes": 10, "alias_bytes": 20,
+                               "argument_bytes": 40},
+        },
+    }
+    if with_index_bytes:
+        rec["sharded"]["rollout_memory"]["index_plane_bytes"] = 30
+        rec["sharded"]["rollout_memory"]["alias_frac"] = 0.5
+    if with_mem:
+        rec["mem"] = {
+            "n_peers": n_peers, "n_slots": 32, "conn_degree": 16,
+            "msg_window": 64,
+            "models": {"gossipsub": {
+                "narrow": {"total_bytes": 100, "bytes_per_peer": 10.0,
+                           "plane_bytes": {"index": 4, "mesh": 6}},
+                "int32": {"total_bytes": 120, "bytes_per_peer": 12.0},
+                "index_plane_reduction": 0.5,
+                "nbrs_rev_reduction": 0.5,
+            }},
+        }
+    return rec
+
+
+def _run_perf_diff(tmp_path, old_rec, new_rec):
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    old.write_text(json.dumps(old_rec))
+    new.write_text(json.dumps(new_rec))
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "perf_diff.py"),
+         str(old), str(new)],
+        capture_output=True, text=True,
+    )
+
+
+def test_perf_diff_warns_on_pre_r22_record(tmp_path):
+    out = _run_perf_diff(
+        tmp_path,
+        _mem_record(False, with_index_bytes=False),
+        _mem_record(True),
+    )
+    assert out.returncode == 0, out.stderr
+    assert "'mem' section" in out.stdout
+    assert "missing in old" in out.stdout
+    assert "added in r22" in out.stdout
+    assert "index_plane_bytes" in out.stdout
+    # The one-sided rows still render (with "-" on the old side).
+    assert "mem gossipsub bytes/peer" in out.stdout
+    assert "mem gossipsub index plane (bytes)" in out.stdout
+
+
+def test_perf_diff_compares_matching_r22_records(tmp_path):
+    out = _run_perf_diff(tmp_path, _mem_record(True), _mem_record(True))
+    assert out.returncode == 0, out.stderr
+    assert "missing in" not in out.stdout
+    assert "sharded rollout alias frac" in out.stdout
+    # Geometry drift between audits is called out, not averaged over.
+    out = _run_perf_diff(
+        tmp_path, _mem_record(True), _mem_record(True, n_peers=8192)
+    )
+    assert out.returncode == 0, out.stderr
+    assert "mem audit n_peers differs" in out.stdout
+
+
+@pytest.mark.slow
+def test_bench_phase_breakdown_on_narrow_state():
+    """Regression: ``bench.phase_breakdown`` widens the state for the raw
+    sub-phase kernels but must hand ``gs.run`` the STORAGE view — the
+    rollout scan carries narrow planes, so a widened carry input meets a
+    narrowed carry output and the scan refuses the mismatched dtypes."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    gs = GossipSub(n_peers=96, n_slots=8, conn_degree=4, msg_window=8,
+                   heartbeat_steps=2, use_pallas=False)
+    st = gs.init(0)
+    assert st.nbrs.dtype == np.uint16
+    phases = bench.phase_breakdown(gs, st, reps=1)
+    assert "round_amortized" in phases and "propagate" in phases
+    assert all(v >= 0.0 for v in phases.values())

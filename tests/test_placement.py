@@ -32,6 +32,7 @@ from go_libp2p_pubsub_tpu.models.gossipsub import (
     GossipSub, build_topology_local,
 )
 from go_libp2p_pubsub_tpu.ops import gossip_packed as gp
+from go_libp2p_pubsub_tpu.ops.graphs import decode_index_plane
 from go_libp2p_pubsub_tpu.parallel.gossip_sharded import ShardedGossipSub
 from go_libp2p_pubsub_tpu.parallel.mesh import make_mesh
 from go_libp2p_pubsub_tpu.parallel.placement import (
@@ -109,7 +110,10 @@ def _canonical_equal(field, xa, xb, inv, perm, n):
     """Physical leaf ``xb`` equals canonical leaf ``xa`` under the inverse
     relabeling.  ``nbrs`` holds peer IDS, so its values map through perm."""
     if field == "nbrs":
-        xbc = xb[inv]
+        # Compare on the decoded signed view: the narrow storage (r22)
+        # wrap-encodes the -1 sentinel, which must not map through perm.
+        xa = np.asarray(decode_index_plane(xa))
+        xbc = np.asarray(decode_index_plane(xb))[inv]
         return np.array_equal(
             np.where(xbc >= 0, perm[np.clip(xbc, 0, n - 1)], xbc), xa
         )

@@ -216,18 +216,27 @@ def test_k_of_n_any_subset_decode():
     coeffs = rng.integers(0, 256, (N, K)).astype(np.uint8)
     frags = np.asarray(gf256.gf_matmul(jnp.asarray(coeffs),
                                        jnp.asarray(payload)))
+    import jax
+
+    @jax.jit
+    def solve_and_stream(a, b):
+        x, ok = gf256.gf_solve(a, b)
+        # independence judged by the streaming kernel — both decode paths
+        # must agree on which subsets are decodable
+        def insert(basis, row):
+            basis, _ = gf256.rref_insert(basis, row)
+            return basis, ()
+
+        basis, _ = jax.lax.scan(insert, jnp.zeros((K, K), jnp.uint8), a)
+        return x, ok, gf256.gf_rank(basis)
+
     decoded = dependent = 0
     from itertools import combinations
     for sub in combinations(range(N), K):
         a = jnp.asarray(coeffs[list(sub)])
         b = jnp.asarray(frags[list(sub)])
-        x, ok = gf256.gf_solve(a, b)
-        # independence judged by the streaming kernel — both decode paths
-        # must agree on which subsets are decodable
-        basis = jnp.zeros((K, K), jnp.uint8)
-        for r in list(sub):
-            basis, _ = gf256.rref_insert(basis, jnp.asarray(coeffs[r]))
-        assert bool(ok) == (int(gf256.gf_rank(basis)) == K)
+        x, ok, rank = solve_and_stream(a, b)
+        assert bool(ok) == (int(rank) == K)
         if bool(ok):
             assert (np.asarray(x) == payload).all()
             decoded += 1

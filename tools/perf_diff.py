@@ -234,6 +234,33 @@ SCALAR_ROWS: List[Tuple[Tuple[str, ...], str, bool]] = [
      "sharded rollout temp (bytes/device)", False),
     (("sharded", "rollout_memory", "alias_bytes"),
      "sharded rollout aliased (bytes/device)", True),
+    # r22: narrow index storage.  The sharded child reports the resident
+    # nbrs+rev bytes per device and the measured donation alias fraction;
+    # the mem section carries the per-family audit (per-plane rows are
+    # collected dynamically in collect_rows — planes may grow between
+    # rounds).  Pre-r22 records show "-" plus a header warning.
+    (("sharded", "rollout_memory", "index_plane_bytes"),
+     "sharded resident index planes (bytes, whole model)", False),
+    (("sharded", "rollout_memory", "alias_frac"),
+     "sharded rollout alias frac", True),
+    (("mem", "models", "gossipsub", "narrow", "total_bytes"),
+     "mem gossipsub resident (bytes)", False),
+    (("mem", "models", "gossipsub", "narrow", "bytes_per_peer"),
+     "mem gossipsub bytes/peer", False),
+    (("mem", "models", "gossipsub", "index_plane_reduction"),
+     "mem gossipsub index-plane reduction", True),
+    (("mem", "models", "gossipsub", "nbrs_rev_reduction"),
+     "mem gossipsub nbrs+rev reduction", True),
+    (("mem", "models", "gossipsub", "rollout_memory", "temp_bytes"),
+     "mem gossipsub rollout temp (bytes)", False),
+    (("mem", "models", "multitopic", "narrow", "bytes_per_peer"),
+     "mem multitopic bytes/peer", False),
+    (("mem", "models", "hybrid", "narrow", "bytes_per_peer"),
+     "mem hybrid bytes/peer", False),
+    (("mem", "models", "rlnc", "narrow", "bytes_per_peer"),
+     "mem rlnc bytes/peer", False),
+    (("mem", "models", "rlnc", "index_plane_reduction"),
+     "mem rlnc index-plane reduction", True),
 ]
 
 
@@ -342,6 +369,25 @@ def collect_rows(old: Dict[str, Any], new: Dict[str, Any], threshold: float):
             n = dig(new, ("sharded", "phase_split_ms", ph, k))
             delta, flag = classify(o, n, False, threshold)
             rows.append((f"sharded {ph}.{k}", fmt(o), fmt(n), delta, flag))
+    # mem-audit per-plane resident bytes (r22): the gossipsub narrow arm is
+    # the flagship budget, and planes may grow between rounds, so rows are
+    # collected dynamically from whichever sides carry them.
+    def _mem_planes(d):
+        m = d.get("mem")
+        fam = m.get("models", {}).get("gossipsub") if isinstance(m, dict) \
+            else None
+        if not isinstance(fam, dict):
+            return {}
+        return (fam.get("narrow") or {}).get("plane_bytes") or {}
+
+    for p in sorted(set(_mem_planes(old)) | set(_mem_planes(new))):
+        o = dig(old, ("mem", "models", "gossipsub", "narrow",
+                      "plane_bytes", p))
+        n = dig(new, ("mem", "models", "gossipsub", "narrow",
+                      "plane_bytes", p))
+        delta, flag = classify(o, n, False, threshold)
+        rows.append((f"mem gossipsub {p} plane (bytes)", fmt(o), fmt(n),
+                     delta, flag))
     return rows
 
 
@@ -631,6 +677,45 @@ def context_warnings(old: Dict[str, Any], new: Dict[str, Any]) -> List[str]:
                 f"promoted defense changed between rounds: "
                 f"{vo['promoted_digest']} -> {vn['promoted_digest']} "
                 f"(re-check the audit's margin table)"
+            )
+    # Memory-audit section (r22+): a pre-r22 record never ran the
+    # per-buffer audit — warn, don't crash.
+    ao, an = old.get("mem"), new.get("mem")
+    if (ao is None) != (an is None):
+        which = "old" if ao is None else "new"
+        warns.append(
+            f"only one record has a 'mem' section (missing in {which}; "
+            f"added in r22) — memory-audit rows are one-sided"
+        )
+    for name, s in (("old", ao), ("new", an)):
+        if isinstance(s, dict) and "error" in s:
+            warns.append(
+                f"{name} mem section is an error record: "
+                f"{str(s['error'])[:200]}"
+            )
+    if (isinstance(ao, dict) and isinstance(an, dict)
+            and "error" not in ao and "error" not in an):
+        for key in ("n_peers", "n_slots", "conn_degree", "msg_window"):
+            if ao.get(key) != an.get(key):
+                warns.append(
+                    f"mem audit {key} differs: {ao.get(key)!r} vs "
+                    f"{an.get(key)!r} — resident-bytes rows compare "
+                    f"different geometries"
+                )
+    # r22 also narrowed the sharded index planes: a pre-r22 sharded record
+    # lacks index_plane_bytes/alias_frac — those rows are one-sided.
+    if (isinstance(so, dict) and isinstance(sn, dict)
+            and "error" not in so and "error" not in sn):
+        rmo, rmn = (so.get("rollout_memory") or {}), \
+                   (sn.get("rollout_memory") or {})
+        if (isinstance(rmo, dict) and isinstance(rmn, dict)
+                and ("index_plane_bytes" in rmo)
+                != ("index_plane_bytes" in rmn)):
+            which = "old" if "index_plane_bytes" not in rmo else "new"
+            warns.append(
+                f"only one record reports sharded rollout "
+                f"index_plane_bytes (missing in {which}; added in r22) — "
+                f"the resident index-plane row is one-sided"
             )
     return warns
 
